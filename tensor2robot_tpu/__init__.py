@@ -1,0 +1,29 @@
+"""tensor2robot_tpu — a TPU-native (JAX/XLA/pjit/Pallas) robot-learning framework.
+
+A ground-up rebuild of the capabilities of ``sharmasecureservices/tensor2robot``
+(a TF1/Estimator-era robot-learning harness), re-designed TPU-first:
+
+- a typed tensor-spec system (``tensor2robot_tpu.specs``) that drives data
+  parsing, preprocessing, device feeding, export signatures, and on-robot
+  inference from a single model definition;
+- a portable model abstraction (``tensor2robot_tpu.models``) built on Flax,
+  with regression / classification / critic base classes;
+- synchronous data-parallel (and model-parallel-capable) training over a
+  ``jax.sharding.Mesh`` (``tensor2robot_tpu.parallel``,
+  ``tensor2robot_tpu.train``) — XLA collectives over ICI/DCN replace the
+  reference's CrossShardOptimizer / NCCL all-reduce;
+- async checkpointing (Orbax), EMA parameter averaging, and hot-reloadable
+  export (jax2tf SavedModel so existing robot serving is unchanged, plus a
+  pure-JAX predictor path);
+- MAML-style meta-learning as a model transformer
+  (``tensor2robot_tpu.meta_learning``);
+- research workloads: pose_env reaching, QT-Opt grasping Q-function (+ CEM),
+  Grasp2Vec, VRGripper BC (``tensor2robot_tpu.research``).
+
+Reference parity map: SURVEY.md §2 (component inventory). The reference mount
+was empty during the survey (SURVEY.md §0); reference citations in docstrings
+are of the form ``<file> §<symbol>`` against the upstream
+``google-research/tensor2robot`` layout reconstructed there.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,54 @@
+"""Typed tensor-spec system — the lingua franca of tensor2robot_tpu.
+
+Reference parity: utils/tensorspec_utils.py (SURVEY.md §2 "Spec system").
+"""
+
+from tensor2robot_tpu.specs.tensorspec_utils import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    FeatureSchema,
+    add_batch,
+    assert_equal,
+    assert_valid_spec_structure,
+    copy_tensorspec,
+    filter_required_flat_tensor_spec,
+    flatten_spec_structure,
+    from_serialized,
+    is_encoded_image_spec,
+    make_placeholders,
+    make_random_array,
+    make_random_batch,
+    pack_flat_sequence_to_spec_structure,
+    pad_or_clip_array,
+    replace_dtype,
+    to_serialized,
+    tensorspec_from_array,
+    tensorspec_to_feature_dict,
+    validate_and_flatten,
+    validate_and_pack,
+)
+
+__all__ = [
+    "ExtendedTensorSpec",
+    "TensorSpecStruct",
+    "FeatureSchema",
+    "add_batch",
+    "assert_equal",
+    "assert_valid_spec_structure",
+    "copy_tensorspec",
+    "filter_required_flat_tensor_spec",
+    "flatten_spec_structure",
+    "from_serialized",
+    "is_encoded_image_spec",
+    "make_placeholders",
+    "make_random_array",
+    "make_random_batch",
+    "pack_flat_sequence_to_spec_structure",
+    "pad_or_clip_array",
+    "replace_dtype",
+    "to_serialized",
+    "tensorspec_from_array",
+    "tensorspec_to_feature_dict",
+    "validate_and_flatten",
+    "validate_and_pack",
+]

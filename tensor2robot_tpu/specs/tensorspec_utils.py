@@ -1,0 +1,867 @@
+"""Typed tensor specs with robot-data extras — the framework's central abstraction.
+
+One spec structure, declared once per model, drives:
+
+- tf.Example/TFRecord parsing schemas (``tensorspec_to_feature_dict``),
+- preprocessing contracts (spec-in/spec-out, ``preprocessors``),
+- host→device feeding and sharding (shapes/dtypes are static, XLA-friendly),
+- export signatures and on-robot input validation (``export``/``predictors``),
+- spec-conformant random data for the mock test stack (``make_random_batch``).
+
+Reference parity: ``utils/tensorspec_utils.py`` §ExtendedTensorSpec,
+§TensorSpecStruct, §flatten_spec_structure, §pack_flat_sequence_to_spec_structure,
+§validate_and_pack, §validate_and_flatten, §tensorspec_to_feature_dict,
+§filter_required_flat_tensor_spec, §is_encoded_image_spec, §pad_or_clip_tensor
+(SURVEY.md §2; reconstructed — see SURVEY.md §0).
+
+TPU-first design notes: specs are frozen, hashable pytree-compatible
+dataclasses over plain ``(shape, dtype)`` — they interop directly with
+``jax.ShapeDtypeStruct`` (``.to_shape_dtype_struct()``) so a spec structure
+can be fed straight into ``jax.eval_shape`` / AOT compilation, and all shapes
+are static by construction (no dynamic shapes reach XLA).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from collections import OrderedDict
+from collections.abc import Mapping, MutableMapping
+from typing import Any, Callable, Iterator, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+# Dtypes normalize through numpy; ml_dtypes (a jax dependency) registers
+# bfloat16/float8 with numpy so np.dtype('bfloat16') round-trips.
+import ml_dtypes  # noqa: F401  (import registers the extension dtypes)
+
+_VALID_KEY_RE = re.compile(r"^[A-Za-z0-9_.\-]+$")
+
+# data_format values that mean "this spec arrives as an encoded image string
+# and must be decoded host-side before it can cross to device".
+_ENCODED_IMAGE_FORMATS = frozenset({"jpeg", "jpg", "png"})
+
+
+def _normalize_dtype(dtype: Any) -> np.dtype:
+  """Normalizes tf/jnp/np/str dtypes to a canonical np.dtype."""
+  if isinstance(dtype, np.dtype):
+    return dtype
+  # jax dtypes, python types, strings, and ml_dtypes all go through np.dtype.
+  try:
+    return np.dtype(dtype)
+  except TypeError:
+    # e.g. jnp.bfloat16 is a type exposing .dtype
+    if hasattr(dtype, "dtype"):
+      return np.dtype(dtype.dtype)
+    raise
+
+
+def _normalize_shape(shape: Any) -> tuple[int, ...]:
+  if shape is None:
+    return ()
+  if isinstance(shape, (int, np.integer)):
+    return (int(shape),)
+  out = []
+  for dim in shape:
+    if dim is None:
+      raise ValueError(
+          "Dynamic (None) dimensions are not supported: every spec must be "
+          "statically shaped so XLA can compile one program per batch shape. "
+          f"Got shape={shape!r}. Use is_sequence + pad_or_clip_array for "
+          "variable-length data."
+      )
+    out.append(int(dim))
+  return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExtendedTensorSpec:
+  """A statically-shaped tensor spec with robot-data extras.
+
+  Equivalent of the reference's ``ExtendedTensorSpec`` (a ``tf.TensorSpec``
+  subclass; utils/tensorspec_utils.py §ExtendedTensorSpec). Shapes never
+  include the batch dimension; ``add_batch`` produces batched variants.
+
+  Attributes:
+    shape: static per-example shape (no batch dim).
+    dtype: canonical numpy dtype (bfloat16 etc. via ml_dtypes).
+    name: optional tensor name (defaults to the struct key when packed).
+    is_optional: packing tolerates this spec being absent from the data.
+    is_sequence: variable-length (ragged over time) feature; parsed as a
+      varlen feature and padded/clipped to ``shape`` host-side.
+    data_format: None for raw numeric data; 'jpeg'/'png' marks an
+      encoded-image feature that is decoded host-side during parsing
+      (encoded strings never cross the host→device boundary).
+    dataset_key: selects which dataset in a multi-dataset input setup this
+      spec is read from ('' = default dataset).
+    varlen_default_value: padding value for varlen parsing; also doubles as
+      the reference's "this is a varlen feature" marker.
+  """
+
+  shape: tuple[int, ...]
+  dtype: np.dtype
+  name: Optional[str] = None
+  is_optional: bool = False
+  is_sequence: bool = False
+  data_format: Optional[str] = None
+  dataset_key: str = ""
+  varlen_default_value: Optional[float] = None
+
+  def __init__(
+      self,
+      shape: Any,
+      dtype: Any,
+      name: Optional[str] = None,
+      is_optional: bool = False,
+      is_sequence: bool = False,
+      data_format: Optional[str] = None,
+      dataset_key: str = "",
+      varlen_default_value: Optional[float] = None,
+  ):
+    object.__setattr__(self, "shape", _normalize_shape(shape))
+    object.__setattr__(self, "dtype", _normalize_dtype(dtype))
+    object.__setattr__(self, "name", name)
+    object.__setattr__(self, "is_optional", bool(is_optional))
+    object.__setattr__(self, "is_sequence", bool(is_sequence))
+    object.__setattr__(
+        self, "data_format", data_format.lower() if data_format else None
+    )
+    object.__setattr__(self, "dataset_key", dataset_key or "")
+    object.__setattr__(self, "varlen_default_value", varlen_default_value)
+
+  # --- constructors -------------------------------------------------------
+
+  @classmethod
+  def from_spec(cls, spec: "ExtendedTensorSpec", **overrides: Any
+                ) -> "ExtendedTensorSpec":
+    """Copies a spec, optionally overriding fields (reference §from_spec)."""
+    kwargs = dict(
+        shape=spec.shape,
+        dtype=spec.dtype,
+        name=spec.name,
+        is_optional=spec.is_optional,
+        is_sequence=spec.is_sequence,
+        data_format=spec.data_format,
+        dataset_key=spec.dataset_key,
+        varlen_default_value=spec.varlen_default_value,
+    )
+    kwargs.update(overrides)
+    return cls(**kwargs)
+
+  @classmethod
+  def from_array(cls, array: Any, name: Optional[str] = None,
+                 **overrides: Any) -> "ExtendedTensorSpec":
+    """Builds a spec describing a (batched or unbatched) concrete array.
+
+    Reads shape/dtype without forcing a device→host transfer for jax arrays.
+    """
+    dtype = getattr(array, "dtype", None)
+    if dtype is None:
+      dtype = np.asarray(array).dtype
+    kwargs = dict(shape=np.shape(array), dtype=dtype, name=name)
+    kwargs.update(overrides)
+    return cls(**kwargs)
+
+  # --- interop ------------------------------------------------------------
+
+  def to_shape_dtype_struct(
+      self, batch_size: Optional[int] = None
+  ) -> jax.ShapeDtypeStruct:
+    """Interop with jax.eval_shape / AOT compilation / sharding APIs."""
+    shape = self.shape if batch_size is None else (batch_size,) + self.shape
+    return jax.ShapeDtypeStruct(shape, self.dtype)
+
+  # --- (de)serialization (export spec assets, proto/t2r.proto parity) -----
+
+  def to_json_dict(self) -> dict[str, Any]:
+    return {
+        "shape": list(self.shape),
+        "dtype": self.dtype.name,
+        "name": self.name,
+        "is_optional": self.is_optional,
+        "is_sequence": self.is_sequence,
+        "data_format": self.data_format,
+        "dataset_key": self.dataset_key,
+        "varlen_default_value": self.varlen_default_value,
+    }
+
+  @classmethod
+  def from_json_dict(cls, d: Mapping[str, Any]) -> "ExtendedTensorSpec":
+    return cls(**dict(d))
+
+  def __repr__(self) -> str:
+    extras = []
+    if self.name:
+      extras.append(f"name={self.name!r}")
+    if self.is_optional:
+      extras.append("is_optional=True")
+    if self.is_sequence:
+      extras.append("is_sequence=True")
+    if self.data_format:
+      extras.append(f"data_format={self.data_format!r}")
+    if self.dataset_key:
+      extras.append(f"dataset_key={self.dataset_key!r}")
+    if self.varlen_default_value is not None:
+      extras.append(f"varlen_default_value={self.varlen_default_value!r}")
+    extra = (", " + ", ".join(extras)) if extras else ""
+    return f"ExtendedTensorSpec({self.shape}, {self.dtype.name}{extra})"
+
+
+TensorOrSpec = Union[ExtendedTensorSpec, np.ndarray, jax.Array]
+
+
+def tensorspec_from_array(array: Any, name: Optional[str] = None
+                          ) -> ExtendedTensorSpec:
+  """Spec describing a concrete (jax or numpy) array."""
+  return ExtendedTensorSpec.from_array(array, name=name)
+
+
+def is_encoded_image_spec(spec: ExtendedTensorSpec) -> bool:
+  """True if the spec arrives as an encoded image (jpeg/png) byte string.
+
+  Reference: utils/tensorspec_utils.py §is_encoded_image_spec.
+  """
+  return (spec.data_format or "") in _ENCODED_IMAGE_FORMATS
+
+
+def copy_tensorspec(
+    spec_structure: "SpecStructure",
+    prefix: str = "",
+    batch_size: Optional[int] = None,
+) -> "TensorSpecStruct":
+  """Deep-copies a spec structure, optionally prefixing names / batching.
+
+  Reference: utils/tensorspec_utils.py §copy_tensorspec.
+  """
+  flat = flatten_spec_structure(spec_structure)
+  out = TensorSpecStruct()
+  for key, spec in flat.items():
+    name = spec.name if spec.name is not None else key.rsplit("/", 1)[-1]
+    if prefix:
+      name = f"{prefix}/{name}"
+    shape = spec.shape
+    if batch_size is not None:
+      shape = (batch_size,) + shape
+    out[key] = ExtendedTensorSpec.from_spec(spec, shape=shape, name=name)
+  return out
+
+
+def replace_dtype(
+    spec_structure: "SpecStructure",
+    from_dtype: Any,
+    to_dtype: Any,
+) -> "TensorSpecStruct":
+  """Returns a copy with every ``from_dtype`` spec converted to ``to_dtype``.
+
+  The TPU-feeding analogue of the reference's TPUPreprocessorWrapper dtype
+  conversion (preprocessors §TPUPreprocessorWrapper): e.g. uint8 → bfloat16
+  before infeed.
+  """
+  from_dtype = _normalize_dtype(from_dtype)
+  to_dtype = _normalize_dtype(to_dtype)
+  flat = flatten_spec_structure(spec_structure)
+  out = TensorSpecStruct()
+  for key, spec in flat.items():
+    if spec.dtype == from_dtype:
+      spec = ExtendedTensorSpec.from_spec(spec, dtype=to_dtype)
+    out[key] = spec
+  return out
+
+
+# ---------------------------------------------------------------------------
+# TensorSpecStruct
+# ---------------------------------------------------------------------------
+
+
+class TensorSpecStruct(MutableMapping):
+  """Ordered, attribute-accessible, nestable container for specs or tensors.
+
+  The working data structure of the whole framework (reference
+  utils/tensorspec_utils.py §TensorSpecStruct). Internally a single flat
+  ordered dict keyed by '/'-separated paths; attribute or item access on an
+  intermediate path returns a live *view* onto the subtree:
+
+      s = TensorSpecStruct()
+      s['train/images'] = spec_a
+      s['train/actions'] = spec_b
+      s.train.images is spec_a          # attribute access
+      dict(s.train)                     # {'images': spec_a, 'actions': spec_b}
+      s['val'] = {'images': spec_c}     # nested assignment flattens
+
+  Iteration yields flat paths relative to the view's prefix, in insertion
+  order. Registered as a jax pytree node, so ``jax.tree_util`` / ``jit``
+  arguments can be TensorSpecStructs of arrays.
+  """
+
+  __slots__ = ("_data", "_prefix")
+
+  def __init__(self, *args: Any, **kwargs: Any):
+    object.__setattr__(self, "_data", OrderedDict())
+    object.__setattr__(self, "_prefix", "")
+    init = OrderedDict()
+    if args:
+      if len(args) > 1:
+        raise TypeError("TensorSpecStruct expects at most one positional arg")
+      src = args[0]
+      if isinstance(src, TensorSpecStruct):
+        init.update(src.items())
+      elif isinstance(src, Mapping):
+        init.update(src)
+      elif src is not None:
+        init.update(OrderedDict(src))
+    init.update(kwargs)
+    for key, value in init.items():
+      self[key] = value
+
+  # --- view construction --------------------------------------------------
+
+  @classmethod
+  def _view(cls, data: OrderedDict, prefix: str) -> "TensorSpecStruct":
+    obj = cls.__new__(cls)
+    object.__setattr__(obj, "_data", data)
+    object.__setattr__(obj, "_prefix", prefix)
+    return obj
+
+  def _abs(self, key: str) -> str:
+    if not isinstance(key, str):
+      raise TypeError(f"TensorSpecStruct keys are strings, got {key!r}")
+    return f"{self._prefix}{key}"
+
+  # --- mapping protocol ---------------------------------------------------
+
+  def __getitem__(self, key: str) -> Any:
+    abs_key = self._abs(key)
+    if abs_key in self._data:
+      return self._data[abs_key]
+    sub_prefix = abs_key + "/"
+    if any(k.startswith(sub_prefix) for k in self._data):
+      return TensorSpecStruct._view(self._data, sub_prefix)
+    raise KeyError(key)
+
+  def __setitem__(self, key: str, value: Any) -> None:
+    abs_key = self._abs(key)
+    for part in key.split("/"):
+      if not _VALID_KEY_RE.match(part):
+        raise ValueError(
+            f"Invalid key part {part!r} in {key!r}: keys must match "
+            f"{_VALID_KEY_RE.pattern} (no empty segments)."
+        )
+    if isinstance(value, (TensorSpecStruct, Mapping)):
+      items = value.items()
+      if not items and isinstance(value, Mapping):
+        raise ValueError(f"Cannot assign an empty mapping to key {key!r}.")
+      for sub_key, sub_value in list(items):
+        self[f"{key}/{sub_key}"] = sub_value
+      return
+    if abs_key in self._data:
+      self._data[abs_key] = value
+      return
+    # Refuse to shadow an existing subtree with a leaf.
+    sub_prefix = abs_key + "/"
+    if any(k.startswith(sub_prefix) for k in self._data):
+      raise ValueError(
+          f"Key {key!r} already names a subtree; cannot overwrite it with a "
+          "leaf value. Delete the subtree first."
+      )
+    self._data[abs_key] = value
+
+  def __delitem__(self, key: str) -> None:
+    abs_key = self._abs(key)
+    if abs_key in self._data:
+      del self._data[abs_key]
+      return
+    sub_prefix = abs_key + "/"
+    doomed = [k for k in self._data if k.startswith(sub_prefix)]
+    if not doomed:
+      raise KeyError(key)
+    for k in doomed:
+      del self._data[k]
+
+  def __iter__(self) -> Iterator[str]:
+    plen = len(self._prefix)
+    for k in list(self._data):
+      if k.startswith(self._prefix):
+        yield k[plen:]
+
+  def __len__(self) -> int:
+    return sum(1 for _ in self)
+
+  def __contains__(self, key: object) -> bool:
+    if not isinstance(key, str):
+      return False
+    abs_key = self._abs(key)
+    if abs_key in self._data:
+      return True
+    sub_prefix = abs_key + "/"
+    return any(k.startswith(sub_prefix) for k in self._data)
+
+  # --- attribute protocol -------------------------------------------------
+
+  def __getattr__(self, name: str) -> Any:
+    if name.startswith("_"):
+      raise AttributeError(name)
+    try:
+      return self[name]
+    except KeyError:
+      raise AttributeError(
+          f"TensorSpecStruct has no key or subtree {name!r}; "
+          f"available: {list(self)[:20]}"
+      ) from None
+
+  def __setattr__(self, name: str, value: Any) -> None:
+    if name.startswith("_"):
+      object.__setattr__(self, name, value)
+    else:
+      self[name] = value
+
+  def __delattr__(self, name: str) -> None:
+    try:
+      del self[name]
+    except KeyError:
+      raise AttributeError(name) from None
+
+  # --- conveniences -------------------------------------------------------
+
+  def to_dict(self) -> OrderedDict:
+    """Flat dict of path → value, relative to this view's prefix."""
+    return OrderedDict(self.items())
+
+  def to_nested_dict(self) -> OrderedDict:
+    """Nested OrderedDict mirroring the '/'-path hierarchy."""
+    out: OrderedDict = OrderedDict()
+    for key, value in self.items():
+      parts = key.split("/")
+      node = out
+      for part in parts[:-1]:
+        node = node.setdefault(part, OrderedDict())
+      node[parts[-1]] = value
+    return out
+
+  def __repr__(self) -> str:
+    inner = ", ".join(f"{k}={v!r}" for k, v in self.items())
+    return f"TensorSpecStruct({inner})"
+
+  def __eq__(self, other: object) -> bool:
+    if isinstance(other, (TensorSpecStruct, Mapping)):
+      other_items = list(
+          other.items() if isinstance(other, TensorSpecStruct)
+          else flatten_spec_structure(other).items())
+      return list(self.items()) == other_items
+    return NotImplemented
+
+  def __ne__(self, other: object) -> bool:
+    result = self.__eq__(other)
+    return result if result is NotImplemented else not result
+
+
+def _tss_flatten(struct: TensorSpecStruct):
+  items = list(struct.items())
+  keys = tuple(k for k, _ in items)
+  values = tuple(v for _, v in items)
+  return values, keys
+
+
+def _tss_flatten_with_keys(struct: TensorSpecStruct):
+  items = list(struct.items())
+  keys = tuple(k for k, _ in items)
+  keyed = tuple((jax.tree_util.DictKey(k), v) for k, v in items)
+  return keyed, keys
+
+
+def _tss_unflatten(keys, values) -> TensorSpecStruct:
+  out = TensorSpecStruct()
+  for k, v in zip(keys, values):
+    out[k] = v
+  return out
+
+
+jax.tree_util.register_pytree_with_keys(
+    TensorSpecStruct, _tss_flatten_with_keys, _tss_unflatten, _tss_flatten
+)
+
+
+SpecStructure = Union[TensorSpecStruct, Mapping, Any]
+
+
+# ---------------------------------------------------------------------------
+# Flatten / pack / validate
+# ---------------------------------------------------------------------------
+
+
+def flatten_spec_structure(spec_structure: SpecStructure) -> TensorSpecStruct:
+  """Flattens nested mappings / namedtuples / dataclasses to a TensorSpecStruct.
+
+  Reference: utils/tensorspec_utils.py §flatten_spec_structure. Leaves are
+  anything that is not a mapping/namedtuple/dataclass (specs, arrays, rngs…).
+  """
+  out = TensorSpecStruct()
+
+  def _walk(prefix: str, node: Any) -> None:
+    if isinstance(node, TensorSpecStruct):
+      items = node.items()
+    elif isinstance(node, Mapping):
+      items = node.items()
+    elif hasattr(node, "_asdict"):  # namedtuple
+      items = node._asdict().items()
+    elif dataclasses.is_dataclass(node) and not isinstance(
+        node, (ExtendedTensorSpec, type)):
+      items = ((f.name, getattr(node, f.name)) for f in
+               dataclasses.fields(node))
+    else:
+      if prefix == "":
+        raise ValueError(
+            "flatten_spec_structure expects a mapping-like structure at the "
+            f"top level, got {type(node).__name__}."
+        )
+      out[prefix] = node
+      return
+    for key, value in items:
+      sub = f"{prefix}/{key}" if prefix else str(key)
+      _walk(sub, value)
+
+  _walk("", spec_structure)
+  return out
+
+
+def assert_valid_spec_structure(spec_structure: SpecStructure) -> None:
+  """Raises unless every leaf is an ExtendedTensorSpec with a valid key."""
+  flat = flatten_spec_structure(spec_structure)
+  for key, spec in flat.items():
+    if not isinstance(spec, ExtendedTensorSpec):
+      raise ValueError(
+          f"Spec structure leaf {key!r} is {type(spec).__name__}, expected "
+          "ExtendedTensorSpec."
+      )
+
+
+def filter_required_flat_tensor_spec(
+    spec_structure: SpecStructure,
+) -> TensorSpecStruct:
+  """Drops optional specs (reference §filter_required_flat_tensor_spec)."""
+  flat = flatten_spec_structure(spec_structure)
+  out = TensorSpecStruct()
+  for key, spec in flat.items():
+    if not (isinstance(spec, ExtendedTensorSpec) and spec.is_optional):
+      out[key] = spec
+  return out
+
+
+def _shapes_compatible(
+    spec: ExtendedTensorSpec, value_shape: tuple[int, ...],
+    batched: bool,
+) -> bool:
+  expected = spec.shape
+  if not batched:
+    return tuple(value_shape) == expected
+  # Batched: one leading batch dim (any size), rest must match. Sequence
+  # specs additionally get a leading time dim after batch whose padded length
+  # equals spec.shape[0] by parse-time pad_or_clip, so shape already matches.
+  return len(value_shape) == len(expected) + 1 and tuple(
+      value_shape[1:]) == expected
+
+
+def validate_and_flatten(
+    spec_structure: SpecStructure,
+    tensors: SpecStructure,
+    batched: bool = True,
+) -> TensorSpecStruct:
+  """Flattens `tensors` and validates against `spec_structure`.
+
+  Reference: utils/tensorspec_utils.py §validate_and_flatten.
+
+  Args:
+    spec_structure: nested structure of ExtendedTensorSpec.
+    tensors: nested structure of arrays with matching paths.
+    batched: whether arrays carry a leading batch dimension.
+
+  Returns:
+    Flat TensorSpecStruct of validated arrays (required keys only plus any
+    optional keys that were present).
+  """
+  flat_specs = flatten_spec_structure(spec_structure)
+  flat_tensors = flatten_spec_structure(tensors)
+  out = TensorSpecStruct()
+  for key, spec in flat_specs.items():
+    if not isinstance(spec, ExtendedTensorSpec):
+      raise ValueError(f"Spec leaf {key!r} is not an ExtendedTensorSpec.")
+    if key not in flat_tensors:
+      if spec.is_optional:
+        continue
+      raise ValueError(
+          f"Required spec {key!r} missing from tensors; available keys: "
+          f"{list(flat_tensors)}"
+      )
+    value = flat_tensors[key]
+    value_shape = tuple(np.shape(value))
+    value_dtype = (value.dtype if hasattr(value, "dtype")
+                   else np.asarray(value).dtype)
+    if is_encoded_image_spec(spec) and np.dtype(value_dtype).kind in "OSU":
+      # Encoded-image features may legitimately still be byte strings
+      # host-side (pre-decode); numpy coerces lists of bytes to |S dtypes,
+      # hence kind-based detection. Shape validation is deferred to decode.
+      out[key] = value
+      continue
+    if not _shapes_compatible(spec, value_shape, batched):
+      raise ValueError(
+          f"Tensor {key!r} has shape {value_shape}, expected "
+          f"{'batch + ' if batched else ''}{spec.shape}."
+      )
+    if np.dtype(value_dtype) != spec.dtype:
+      raise ValueError(
+          f"Tensor {key!r} has dtype {np.dtype(value_dtype).name}, expected "
+          f"{spec.dtype.name}."
+      )
+    out[key] = value
+  return out
+
+
+def pack_flat_sequence_to_spec_structure(
+    spec_structure: SpecStructure,
+    flat_tensors: SpecStructure,
+    batched: bool = True,
+) -> TensorSpecStruct:
+  """Packs flat tensors into the spec structure's hierarchy, with validation.
+
+  Reference: utils/tensorspec_utils.py §pack_flat_sequence_to_spec_structure.
+  Optional specs absent from `flat_tensors` are dropped; extra tensors not
+  named by any spec are ignored.
+  """
+  return validate_and_flatten(spec_structure, flat_tensors, batched=batched)
+
+
+def validate_and_pack(
+    spec_structure: SpecStructure,
+    tensors: SpecStructure,
+    batched: bool = True,
+) -> TensorSpecStruct:
+  """Validate + pack in one call (reference §validate_and_pack)."""
+  return pack_flat_sequence_to_spec_structure(
+      spec_structure, tensors, batched=batched)
+
+
+def assert_equal(
+    spec_a: SpecStructure, spec_b: SpecStructure, ignore_extras: bool = False
+) -> None:
+  """Asserts two spec structures are equal (reference §assert_equal).
+
+  With ignore_extras, only (shape, dtype) per key are compared.
+  """
+  flat_a = flatten_spec_structure(spec_a)
+  flat_b = flatten_spec_structure(spec_b)
+  keys_a, keys_b = set(flat_a), set(flat_b)
+  if keys_a != keys_b:
+    raise AssertionError(
+        f"Spec key sets differ: only-in-a={sorted(keys_a - keys_b)}, "
+        f"only-in-b={sorted(keys_b - keys_a)}"
+    )
+  for key in flat_a:
+    a, b = flat_a[key], flat_b[key]
+    if ignore_extras:
+      if a.shape != b.shape or a.dtype != b.dtype:
+        raise AssertionError(f"Spec {key!r} differs: {a!r} vs {b!r}")
+    elif a != b:
+      raise AssertionError(f"Spec {key!r} differs: {a!r} vs {b!r}")
+
+
+def add_batch(
+    spec_structure: SpecStructure, batch_size: Optional[int]
+) -> TensorSpecStruct:
+  """Returns specs with a leading batch dimension added (reference §add_batch).
+
+  batch_size=None is disallowed: TPU-native means static shapes everywhere.
+  """
+  if batch_size is None:
+    raise ValueError(
+        "add_batch(batch_size=None) is not supported: all shapes must be "
+        "static for XLA."
+    )
+  flat = flatten_spec_structure(spec_structure)
+  out = TensorSpecStruct()
+  for key, spec in flat.items():
+    out[key] = ExtendedTensorSpec.from_spec(
+        spec, shape=(batch_size,) + spec.shape)
+  return out
+
+
+# ---------------------------------------------------------------------------
+# Parsing schemas (tf.Example) — feeds data/example_proto.py
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureSchema:
+  """Parser schema for one feature inside a serialized tf.Example.
+
+  The framework-native analogue of tf.FixedLenFeature / tf.VarLenFeature
+  (reference §tensorspec_to_feature_dict output). Consumed by
+  data/example_proto.py's parser.
+
+  Attributes:
+    kind: 'fixed' | 'varlen' | 'image' — image means a length-1 bytes
+      feature holding an encoded jpeg/png that decodes to `shape`.
+    shape: the per-example dense shape after parsing (and decode/pad).
+    dtype: output dtype.
+    default_value: pad value for varlen, or None.
+    data_format: image encoding for kind='image'.
+  """
+
+  kind: str
+  shape: tuple[int, ...]
+  dtype: np.dtype
+  default_value: Optional[float] = None
+  data_format: Optional[str] = None
+
+
+def tensorspec_to_feature_dict(
+    spec_structure: SpecStructure, decode_images: bool = True
+) -> "OrderedDict[str, FeatureSchema]":
+  """Builds the per-key parsing schema for serialized tf.Example records.
+
+  Reference: utils/tensorspec_utils.py §tensorspec_to_feature_dict. Keys in
+  the returned dict are the *record* feature names: spec.name if set, else
+  the flat path's last component.
+  """
+  flat = flatten_spec_structure(spec_structure)
+  out: OrderedDict[str, FeatureSchema] = OrderedDict()
+  for key, spec in flat.items():
+    if not isinstance(spec, ExtendedTensorSpec):
+      raise ValueError(f"Spec leaf {key!r} is not an ExtendedTensorSpec.")
+    feature_name = spec.name or key.rsplit("/", 1)[-1]
+    if feature_name in out:
+      # Two spec paths mapping to one record feature is fine (e.g. MAML's
+      # condition/ and inference/ views of the same episode data) — but only
+      # if they agree on how to parse it.
+      prior = out[feature_name]
+      continue_ok = (prior.shape == spec.shape and prior.dtype == spec.dtype)
+      if not continue_ok:
+        raise ValueError(
+            f"Feature name {feature_name!r} is produced by multiple specs "
+            f"with conflicting schemas: {prior!r} vs spec at {key!r} "
+            f"({spec!r}). Give the specs distinct names."
+        )
+      continue
+    if is_encoded_image_spec(spec) and decode_images:
+      out[feature_name] = FeatureSchema(
+          kind="image", shape=spec.shape, dtype=spec.dtype,
+          data_format=spec.data_format)
+    elif spec.is_sequence or spec.varlen_default_value is not None:
+      default = spec.varlen_default_value
+      out[feature_name] = FeatureSchema(
+          kind="varlen", shape=spec.shape, dtype=spec.dtype,
+          default_value=0.0 if default is None else default)
+    else:
+      out[feature_name] = FeatureSchema(
+          kind="fixed", shape=spec.shape, dtype=spec.dtype)
+  return out
+
+
+# ---------------------------------------------------------------------------
+# Array utilities
+# ---------------------------------------------------------------------------
+
+
+def pad_or_clip_array(
+    array: np.ndarray,
+    target_length: int,
+    axis: int = 0,
+    pad_value: float = 0.0,
+) -> np.ndarray:
+  """Pads/clips `array` along `axis` to exactly `target_length`.
+
+  Reference: utils/tensorspec_utils.py §pad_or_clip_tensor. Host-side only
+  (runs in the input pipeline, where shapes may still be ragged); device code
+  never sees dynamic shapes.
+  """
+  array = np.asarray(array)
+  length = array.shape[axis]
+  if length == target_length:
+    return array
+  if length > target_length:
+    index = [slice(None)] * array.ndim
+    index[axis] = slice(0, target_length)
+    return array[tuple(index)]
+  pad_widths = [(0, 0)] * array.ndim
+  pad_widths[axis] = (0, target_length - length)
+  return np.pad(array, pad_widths, mode="constant",
+                constant_values=pad_value)
+
+
+def make_random_array(
+    spec: ExtendedTensorSpec,
+    batch_size: Optional[int] = None,
+    rng: Optional[np.random.Generator] = None,
+) -> np.ndarray:
+  """Spec-conformant random numpy array (the mock-stack workhorse).
+
+  Reference behavior: input_generators §DefaultRandomInputGenerator's
+  per-spec synthesis. Floats ~ U[0,1); ints ~ U[0, 10); bools ~ Bernoulli.
+  """
+  rng = rng or np.random.default_rng(0)
+  shape = spec.shape if batch_size is None else (batch_size,) + spec.shape
+  if np.issubdtype(spec.dtype, np.floating) or spec.dtype == np.dtype(
+      "bfloat16"):
+    return rng.random(shape, dtype=np.float64).astype(spec.dtype)
+  if spec.dtype == np.dtype(bool):
+    return rng.random(shape) < 0.5
+  if np.issubdtype(spec.dtype, np.integer):
+    high = min(10, np.iinfo(spec.dtype).max)
+    return rng.integers(0, high, size=shape).astype(spec.dtype)
+  raise ValueError(f"Cannot synthesize random data for dtype {spec.dtype}.")
+
+
+def make_random_batch(
+    spec_structure: SpecStructure,
+    batch_size: int,
+    rng: Optional[np.random.Generator] = None,
+    include_optional: bool = True,
+) -> TensorSpecStruct:
+  """Random batch conforming to a whole spec structure."""
+  rng = rng or np.random.default_rng(0)
+  flat = flatten_spec_structure(spec_structure)
+  out = TensorSpecStruct()
+  for key, spec in flat.items():
+    if spec.is_optional and not include_optional:
+      continue
+    out[key] = make_random_array(spec, batch_size=batch_size, rng=rng)
+  return out
+
+
+def make_placeholders(
+    spec_structure: SpecStructure, batch_size: Optional[int] = None
+) -> TensorSpecStruct:
+  """jax.ShapeDtypeStruct placeholders for a spec structure.
+
+  Feeds jax.eval_shape / AOT lowering (export path) — the analogue of the
+  reference's placeholder creation in export_generators.
+  """
+  flat = flatten_spec_structure(spec_structure)
+  out = TensorSpecStruct()
+  for key, spec in flat.items():
+    out[key] = spec.to_shape_dtype_struct(batch_size=batch_size)
+  return out
+
+
+# ---------------------------------------------------------------------------
+# Serialization of whole structures (export spec assets)
+# ---------------------------------------------------------------------------
+
+
+def to_serialized(spec_structure: SpecStructure) -> str:
+  """JSON-serializes a spec structure (export asset; proto/t2r.proto parity)."""
+  flat = flatten_spec_structure(spec_structure)
+  payload = OrderedDict(
+      (key, spec.to_json_dict()) for key, spec in flat.items())
+  return json.dumps({"version": 1, "specs": payload}, indent=2)
+
+
+def from_serialized(serialized: str) -> TensorSpecStruct:
+  """Inverse of to_serialized."""
+  payload = json.loads(serialized)
+  if payload.get("version") != 1:
+    raise ValueError(f"Unknown spec serialization version: {payload!r}")
+  out = TensorSpecStruct()
+  for key, d in payload["specs"].items():
+    out[key] = ExtendedTensorSpec.from_json_dict(d)
+  return out

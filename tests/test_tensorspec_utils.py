@@ -1,0 +1,414 @@
+"""Tests for the spec system — the deepest suite, mirroring the reference.
+
+Reference test parity: utils/tensorspec_utils_test.py (SURVEY.md §4: the spec
+system has the deepest coverage — flatten/pack round-trips, optionality,
+varlen, feature-dict conversion).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+)
+
+
+def _image_spec(**kw):
+  return ExtendedTensorSpec((64, 64, 3), np.uint8, name="image",
+                            data_format="jpeg", **kw)
+
+
+def _pose_spec(**kw):
+  return ExtendedTensorSpec((2,), np.float32, name="pose", **kw)
+
+
+class TestExtendedTensorSpec:
+
+  def test_basic_construction(self):
+    spec = ExtendedTensorSpec((4, 3), np.float32)
+    assert spec.shape == (4, 3)
+    assert spec.dtype == np.dtype("float32")
+    assert not spec.is_optional and not spec.is_sequence
+
+  def test_dtype_normalization(self):
+    for d in [jnp.float32, "float32", np.float32, float]:
+      assert ExtendedTensorSpec((1,), d).dtype == np.dtype(
+          "float64" if d is float else "float32")
+
+  def test_bfloat16(self):
+    spec = ExtendedTensorSpec((8, 128), "bfloat16")
+    assert spec.dtype == np.dtype("bfloat16")
+    assert spec.to_shape_dtype_struct().dtype == jnp.bfloat16
+
+  def test_scalar_and_int_shape(self):
+    assert ExtendedTensorSpec((), np.int32).shape == ()
+    assert ExtendedTensorSpec(5, np.int32).shape == (5,)
+
+  def test_dynamic_shape_rejected(self):
+    with pytest.raises(ValueError, match="Dynamic"):
+      ExtendedTensorSpec((None, 3), np.float32)
+
+  def test_from_spec_overrides(self):
+    base = _image_spec(is_optional=True)
+    copy = ExtendedTensorSpec.from_spec(base)
+    assert copy == base
+    changed = ExtendedTensorSpec.from_spec(base, is_optional=False,
+                                           dtype=np.float32)
+    assert not changed.is_optional
+    assert changed.dtype == np.dtype("float32")
+    assert changed.shape == base.shape
+    assert changed.data_format == "jpeg"
+
+  def test_from_array(self):
+    arr = np.zeros((3, 2), np.int64)
+    spec = ExtendedTensorSpec.from_array(arr, name="x")
+    assert spec.shape == (3, 2) and spec.dtype == np.dtype("int64")
+    assert spec.name == "x"
+
+  def test_hashable_and_frozen(self):
+    spec = _pose_spec()
+    assert hash(spec) == hash(ExtendedTensorSpec.from_spec(spec))
+    with pytest.raises(Exception):
+      spec.shape = (3,)  # type: ignore[misc]
+
+  def test_shape_dtype_struct(self):
+    spec = _pose_spec()
+    sds = spec.to_shape_dtype_struct(batch_size=32)
+    assert sds.shape == (32, 2) and sds.dtype == np.dtype("float32")
+
+  def test_json_round_trip(self):
+    spec = _image_spec(is_optional=True, dataset_key="train",
+                       varlen_default_value=-1.0)
+    restored = ExtendedTensorSpec.from_json_dict(
+        json.loads(json.dumps(spec.to_json_dict())))
+    assert restored == spec
+
+  def test_is_encoded_image_spec(self):
+    assert specs.is_encoded_image_spec(_image_spec())
+    assert specs.is_encoded_image_spec(
+        ExtendedTensorSpec((4, 4, 1), np.uint8, data_format="PNG"))
+    assert not specs.is_encoded_image_spec(_pose_spec())
+
+
+class TestTensorSpecStruct:
+
+  def test_flat_and_nested_assignment(self):
+    s = TensorSpecStruct()
+    s["train/images"] = 1
+    s["train/actions"] = 2
+    s["val"] = {"images": 3}
+    assert list(s) == ["train/images", "train/actions", "val/images"]
+    assert s["train/images"] == 1
+    assert s["val/images"] == 3
+
+  def test_attribute_access_and_views(self):
+    s = TensorSpecStruct({"a/b/c": 1, "a/b/d": 2, "a/e": 3})
+    assert s.a.b.c == 1
+    assert dict(s.a.b) == {"c": 1, "d": 2}
+    # Views are live: mutation through the view is visible at the root.
+    s.a.b.c = 10
+    assert s["a/b/c"] == 10
+    s.a.b["f"] = 4
+    assert s["a/b/f"] == 4
+
+  def test_setattr_at_root(self):
+    s = TensorSpecStruct()
+    s.x = 5
+    assert s["x"] == 5
+
+  def test_ordering_preserved(self):
+    s = TensorSpecStruct()
+    for i, k in enumerate(["z", "a", "m/q", "m/b"]):
+      s[k] = i
+    assert list(s) == ["z", "a", "m/q", "m/b"]
+
+  def test_contains_and_len(self):
+    s = TensorSpecStruct({"a/b": 1, "c": 2})
+    assert "a/b" in s and "a" in s and "c" in s
+    assert "nope" not in s and "a/nope" not in s
+    assert len(s) == 2
+    assert len(s.a) == 1
+
+  def test_delete_leaf_and_subtree(self):
+    s = TensorSpecStruct({"a/b": 1, "a/c": 2, "d": 3})
+    del s["a/b"]
+    assert "a/b" not in s
+    del s["a"]
+    assert "a" not in s and "d" in s
+    with pytest.raises(KeyError):
+      del s["a"]
+
+  def test_missing_key_errors(self):
+    s = TensorSpecStruct({"a": 1})
+    with pytest.raises(KeyError):
+      _ = s["b"]
+    with pytest.raises(AttributeError):
+      _ = s.b
+
+  def test_invalid_keys_rejected(self):
+    s = TensorSpecStruct()
+    with pytest.raises(ValueError):
+      s["has space"] = 1
+    with pytest.raises(ValueError):
+      s["a//b"] = 1
+    with pytest.raises(TypeError):
+      s[3] = 1  # type: ignore[index]
+
+  def test_leaf_cannot_shadow_subtree(self):
+    s = TensorSpecStruct({"a/b": 1})
+    with pytest.raises(ValueError, match="subtree"):
+      s["a"] = 5
+
+  def test_to_nested_dict(self):
+    s = TensorSpecStruct({"a/b": 1, "a/c": 2, "d": 3})
+    nested = s.to_nested_dict()
+    assert nested["a"]["b"] == 1 and nested["d"] == 3
+
+  def test_equality(self):
+    a = TensorSpecStruct({"x": 1, "y/z": 2})
+    b = TensorSpecStruct({"x": 1, "y/z": 2})
+    assert a == b
+    b["x"] = 5
+    assert a != b
+
+  def test_init_from_struct_copies(self):
+    a = TensorSpecStruct({"x": 1})
+    b = TensorSpecStruct(a)
+    b["x"] = 2
+    assert a["x"] == 1
+
+  def test_pytree_registration(self):
+    s = TensorSpecStruct({"a/b": jnp.ones((2,)), "c": jnp.zeros((3,))})
+    doubled = jax.tree_util.tree_map(lambda x: x * 2, s)
+    assert isinstance(doubled, TensorSpecStruct)
+    assert list(doubled) == ["a/b", "c"]
+    np.testing.assert_allclose(doubled["a/b"], 2.0)
+
+  def test_pytree_through_jit(self):
+    s = TensorSpecStruct({"x": jnp.arange(4.0), "n/y": jnp.ones((2,))})
+
+    @jax.jit
+    def f(batch):
+      return batch.x.sum() + batch.n.y.sum()
+
+    assert float(f(s)) == pytest.approx(6.0 + 2.0)
+
+
+class TestFlattenPack:
+
+  def _spec_structure(self):
+    return {
+        "visual": {"image": _image_spec()},
+        "pose": _pose_spec(),
+        "extra": ExtendedTensorSpec((5,), np.float32, is_optional=True,
+                                    name="extra"),
+    }
+
+  def test_flatten_nested_dicts(self):
+    flat = specs.flatten_spec_structure(self._spec_structure())
+    assert list(flat) == ["visual/image", "pose", "extra"]
+
+  def test_flatten_rejects_leaf_at_top(self):
+    with pytest.raises(ValueError):
+      specs.flatten_spec_structure(_pose_spec())
+
+  def test_flatten_namedtuple(self):
+    import collections
+    Pair = collections.namedtuple("Pair", ["condition", "inference"])
+    flat = specs.flatten_spec_structure(
+        Pair(condition={"x": 1}, inference={"x": 2}))
+    assert list(flat) == ["condition/x", "inference/x"]
+
+  def test_assert_valid_spec_structure(self):
+    specs.assert_valid_spec_structure(self._spec_structure())
+    with pytest.raises(ValueError):
+      specs.assert_valid_spec_structure({"a": np.zeros(3)})
+
+  def test_validate_and_flatten_happy_path(self):
+    batch = {
+        "visual": {"image": np.zeros((8, 64, 64, 3), np.uint8)},
+        "pose": np.zeros((8, 2), np.float32),
+    }
+    flat = specs.validate_and_flatten(self._spec_structure(), batch)
+    assert list(flat) == ["visual/image", "pose"]  # optional absent → dropped
+
+  def test_validate_optional_present_is_kept(self):
+    batch = {
+        "visual": {"image": np.zeros((8, 64, 64, 3), np.uint8)},
+        "pose": np.zeros((8, 2), np.float32),
+        "extra": np.zeros((8, 5), np.float32),
+    }
+    flat = specs.validate_and_flatten(self._spec_structure(), batch)
+    assert "extra" in flat
+
+  def test_validate_missing_required_raises(self):
+    with pytest.raises(ValueError, match="Required spec 'pose'"):
+      specs.validate_and_flatten(
+          self._spec_structure(),
+          {"visual": {"image": np.zeros((8, 64, 64, 3), np.uint8)}})
+
+  def test_validate_shape_mismatch_raises(self):
+    batch = {
+        "visual": {"image": np.zeros((8, 64, 64, 3), np.uint8)},
+        "pose": np.zeros((8, 3), np.float32),
+    }
+    with pytest.raises(ValueError, match="shape"):
+      specs.validate_and_flatten(self._spec_structure(), batch)
+
+  def test_validate_dtype_mismatch_raises(self):
+    batch = {
+        "visual": {"image": np.zeros((8, 64, 64, 3), np.uint8)},
+        "pose": np.zeros((8, 2), np.float64),
+    }
+    with pytest.raises(ValueError, match="dtype"):
+      specs.validate_and_flatten(self._spec_structure(), batch)
+
+  def test_validate_unbatched(self):
+    batch = {
+        "visual": {"image": np.zeros((64, 64, 3), np.uint8)},
+        "pose": np.zeros((2,), np.float32),
+    }
+    flat = specs.validate_and_flatten(self._spec_structure(), batch,
+                                      batched=False)
+    assert flat["pose"].shape == (2,)
+
+  def test_pack_round_trip(self):
+    spec = self._spec_structure()
+    batch = specs.make_random_batch(spec, batch_size=4)
+    packed = specs.validate_and_pack(spec, batch)
+    assert packed.visual.image.shape == (4, 64, 64, 3)
+    assert packed.pose.shape == (4, 2)
+
+  def test_extra_tensors_ignored(self):
+    batch = {
+        "visual": {"image": np.zeros((8, 64, 64, 3), np.uint8)},
+        "pose": np.zeros((8, 2), np.float32),
+        "surprise": np.zeros((8, 9), np.float32),
+    }
+    packed = specs.validate_and_pack(self._spec_structure(), batch)
+    assert "surprise" not in packed
+
+  def test_filter_required(self):
+    required = specs.filter_required_flat_tensor_spec(self._spec_structure())
+    assert list(required) == ["visual/image", "pose"]
+
+  def test_add_batch(self):
+    batched = specs.add_batch(self._spec_structure(), 16)
+    assert batched["pose"].shape == (16, 2)
+    with pytest.raises(ValueError):
+      specs.add_batch(self._spec_structure(), None)
+
+  def test_assert_equal(self):
+    specs.assert_equal(self._spec_structure(), self._spec_structure())
+    other = self._spec_structure()
+    other["pose"] = ExtendedTensorSpec((3,), np.float32, name="pose")
+    with pytest.raises(AssertionError):
+      specs.assert_equal(self._spec_structure(), other)
+
+  def test_replace_dtype(self):
+    converted = specs.replace_dtype(
+        self._spec_structure(), np.uint8, "bfloat16")
+    assert converted["visual/image"].dtype == np.dtype("bfloat16")
+    assert converted["pose"].dtype == np.dtype("float32")
+
+
+class TestFeatureDictAndSerialization:
+
+  def test_tensorspec_to_feature_dict(self):
+    spec = {
+        "image": _image_spec(),
+        "pose": _pose_spec(),
+        "steps": ExtendedTensorSpec((10, 3), np.float32, name="steps",
+                                    is_sequence=True,
+                                    varlen_default_value=-1.0),
+    }
+    schema = specs.tensorspec_to_feature_dict(spec)
+    assert schema["image"].kind == "image"
+    assert schema["image"].data_format == "jpeg"
+    assert schema["pose"].kind == "fixed"
+    assert schema["steps"].kind == "varlen"
+    assert schema["steps"].default_value == -1.0
+
+  def test_feature_dict_collision_same_schema_ok(self):
+    spec = {
+        "condition/pose": _pose_spec(),
+        "inference/pose": _pose_spec(),
+    }
+    schema = specs.tensorspec_to_feature_dict(spec)
+    assert list(schema) == ["pose"]
+
+  def test_feature_dict_collision_conflict_raises(self):
+    spec = {
+        "a/depth": ExtendedTensorSpec((64, 64, 1), np.float32),
+        "b/depth": ExtendedTensorSpec((32, 32, 1), np.uint8),
+    }
+    with pytest.raises(ValueError, match="conflicting"):
+      specs.tensorspec_to_feature_dict(spec)
+
+  def test_encoded_image_bytes_passthrough(self):
+    # numpy coerces lists of bytes to |S dtype; pre-decode validation must
+    # still pass encoded image features through.
+    spec = {"image": _image_spec()}
+    raw = np.asarray([b"\xff\xd8fake"] * 4)
+    flat = specs.validate_and_flatten(spec, {"image": raw})
+    assert flat["image"] is raw
+
+  def test_feature_dict_uses_spec_name(self):
+    spec = {"nested/deep/key": ExtendedTensorSpec((1,), np.float32,
+                                                  name="record_name")}
+    schema = specs.tensorspec_to_feature_dict(spec)
+    assert list(schema) == ["record_name"]
+
+  def test_serialization_round_trip(self):
+    structure = {
+        "visual": {"image": _image_spec(is_optional=True)},
+        "pose": _pose_spec(),
+    }
+    restored = specs.from_serialized(specs.to_serialized(structure))
+    specs.assert_equal(structure, restored)
+
+
+class TestArrayUtils:
+
+  def test_pad_or_clip(self):
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    padded = specs.pad_or_clip_array(arr, 5, axis=0, pad_value=-1)
+    assert padded.shape == (5, 4)
+    assert (padded[3:] == -1).all()
+    clipped = specs.pad_or_clip_array(arr, 2, axis=1)
+    assert clipped.shape == (3, 2)
+    same = specs.pad_or_clip_array(arr, 3, axis=0)
+    assert same.shape == (3, 4)
+
+  def test_make_random_array_dtypes(self):
+    rng = np.random.default_rng(42)
+    for dtype in [np.float32, "bfloat16", np.int32, np.uint8, bool]:
+      spec = ExtendedTensorSpec((4, 2), dtype)
+      arr = specs.make_random_array(spec, batch_size=3, rng=rng)
+      assert arr.shape == (3, 4, 2)
+      assert arr.dtype == np.dtype(dtype)
+
+  def test_make_random_batch_validates(self):
+    structure = {
+        "image": ExtendedTensorSpec((8, 8, 3), np.uint8),
+        "pose": _pose_spec(),
+    }
+    batch = specs.make_random_batch(structure, batch_size=2)
+    specs.validate_and_flatten(structure, batch)
+
+  def test_make_placeholders(self):
+    structure = {"pose": _pose_spec()}
+    ph = specs.make_placeholders(structure, batch_size=7)
+    assert ph["pose"].shape == (7, 2)
+
+  def test_copy_tensorspec_prefix(self):
+    copied = specs.copy_tensorspec({"pose": _pose_spec()}, prefix="cond",
+                                   batch_size=4)
+    assert copied["pose"].name == "cond/pose"
+    assert copied["pose"].shape == (4, 2)
