@@ -1,0 +1,32 @@
+"""Data ingestion: record IO, example parsing, input generators, prefetch.
+
+Reference parity: input_generators/ + the TF C++ RecordInput/parse_example
+kernels the reference leaned on (SURVEY.md §2 "Input generators", §2 native
+components table).
+"""
+
+from tensor2robot_tpu.data import example_proto
+from tensor2robot_tpu.data import tfrecord
+from tensor2robot_tpu.data.abstract_input_generator import (
+    AbstractInputGenerator,
+)
+from tensor2robot_tpu.data.default_input_generator import (
+    DefaultRandomInputGenerator,
+    DefaultRecordInputGenerator,
+    FractionalRecordInputGenerator,
+    WeightedRecordInputGenerator,
+)
+from tensor2robot_tpu.data.parser import ExampleParser
+from tensor2robot_tpu.data.prefetch import prefetch_to_device
+
+__all__ = [
+    "AbstractInputGenerator",
+    "DefaultRandomInputGenerator",
+    "DefaultRecordInputGenerator",
+    "ExampleParser",
+    "FractionalRecordInputGenerator",
+    "WeightedRecordInputGenerator",
+    "example_proto",
+    "prefetch_to_device",
+    "tfrecord",
+]

@@ -1,0 +1,128 @@
+"""Abstract input generator — builds host-side batch iterators from specs.
+
+Reference parity: input_generators/abstract_input_generator.py
+§AbstractInputGenerator (SURVEY.md §2). Where the reference produced an
+Estimator ``input_fn`` returning a tf.data graph, the rebuild produces a
+plain Python factory of numpy batch iterators: parsing/decode/preprocess run
+host-side, and ``data.prefetch_to_device`` overlaps the H2D transfer with
+compute under whatever sharding the trainer passes.
+
+Per-host data sharding (the TPUEstimator per-host input_fn equivalent) is a
+first-class constructor arg: ``shard_index/num_shards`` partition files (or
+the random stream) so each host feeds only its slice of the global batch.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Iterator, Optional, Tuple
+
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+# A batch is (features, labels) — both flat TensorSpecStructs of numpy
+# arrays with a leading (per-host) batch dim.
+Batch = Tuple[ts.TensorSpecStruct, ts.TensorSpecStruct]
+
+TRAIN = "train"
+EVAL = "eval"
+PREDICT = "predict"
+_MODES = (TRAIN, EVAL, PREDICT)
+
+
+class AbstractInputGenerator(abc.ABC):
+  """Builds per-host batch iterators conforming to a model's specs."""
+
+  def __init__(
+      self,
+      batch_size: int = 32,
+      shard_index: int = 0,
+      num_shards: int = 1,
+  ):
+    if batch_size <= 0:
+      raise ValueError(f"batch_size must be positive, got {batch_size}")
+    if not 0 <= shard_index < num_shards:
+      raise ValueError(
+          f"shard_index {shard_index} out of range for {num_shards} shards")
+    self._batch_size = batch_size
+    self._shard_index = shard_index
+    self._num_shards = num_shards
+    self._feature_spec: Optional[ts.TensorSpecStruct] = None
+    self._label_spec: Optional[ts.TensorSpecStruct] = None
+    self._preprocess_fn: Optional[Callable[..., Batch]] = None
+
+  # --- spec wiring (reference §set_specification_from_model) --------------
+
+  def set_specification_from_model(self, model, mode: str) -> None:
+    """Pulls in/out specs + preprocessor from a T2R model.
+
+    The input pipeline parses what the model's *preprocessor* consumes
+    (its in-specs) and emits what the model consumes (the preprocessor's
+    out-specs), exactly as in the reference's input_fn wiring
+    (SURVEY.md §3.1).
+    """
+    preprocessor = model.preprocessor
+    self.set_specification(
+        feature_spec=preprocessor.get_in_feature_specification(mode),
+        label_spec=preprocessor.get_in_label_specification(mode),
+    )
+    self._preprocess_fn = lambda features, labels: preprocessor.preprocess(
+        features, labels, mode)
+
+  def set_specification(
+      self,
+      feature_spec: ts.SpecStructure,
+      label_spec: Optional[ts.SpecStructure] = None,
+  ) -> None:
+    ts.assert_valid_spec_structure(feature_spec)
+    self._feature_spec = ts.flatten_spec_structure(feature_spec)
+    if label_spec is not None:
+      ts.assert_valid_spec_structure(label_spec)
+      self._label_spec = ts.flatten_spec_structure(label_spec)
+    else:
+      self._label_spec = ts.TensorSpecStruct()
+
+  @property
+  def batch_size(self) -> int:
+    """Per-host batch size (global batch = batch_size × num_hosts)."""
+    return self._batch_size
+
+  @property
+  def feature_spec(self) -> ts.TensorSpecStruct:
+    self._assert_specs_set()
+    return self._feature_spec
+
+  @property
+  def label_spec(self) -> ts.TensorSpecStruct:
+    self._assert_specs_set()
+    return self._label_spec
+
+  def _assert_specs_set(self) -> None:
+    if self._feature_spec is None:
+      raise ValueError(
+          "Input generator has no specs; call set_specification_from_model "
+          "or set_specification first.")
+
+  # --- pipeline construction ----------------------------------------------
+
+  def create_dataset_fn(self, mode: str) -> Callable[[], Iterator[Batch]]:
+    """Returns a factory of fresh batch iterators for `mode`.
+
+    The factory (not a shared iterator) is returned so train and
+    continuous-eval can each restart their streams — the analogue of the
+    reference's create_dataset_input_fn returning an input_fn.
+    """
+    if mode not in _MODES:
+      raise ValueError(f"Unknown mode {mode!r}; expected one of {_MODES}")
+    self._assert_specs_set()
+
+    def factory() -> Iterator[Batch]:
+      iterator = self._create_iterator(mode)
+      if self._preprocess_fn is None:
+        return iterator
+      return (self._preprocess_fn(f, l) for f, l in iterator)
+
+    return factory
+
+  @abc.abstractmethod
+  def _create_iterator(self, mode: str) -> Iterator[Batch]:
+    """Yields raw (pre-preprocessor) spec-conformant batches."""

@@ -1,0 +1,141 @@
+"""TFRecord file framing (read/write) without TensorFlow.
+
+The reference reads training data via TF's C++ RecordInput/TFRecordDataset
+(SURVEY.md §2 native-components table). This module implements the on-disk
+format directly so the framework owns its IO path:
+
+    each record:  uint64 length (LE)
+                  uint32 masked-crc32c(length bytes) (LE)
+                  byte   data[length]
+                  uint32 masked-crc32c(data) (LE)
+
+CRC32C is the Castagnoli polynomial (0x1EDC6F41, reflected 0x82F63B78), with
+TF's mask: ``((crc >> 15) | (crc << 17)) + 0xa282ead8 (mod 2^32)``.
+
+This pure-Python implementation is the correctness reference; the C++
+extension in data/native is the throughput path and must match it bit-exactly.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+# Table-driven CRC32C via numpy (vectorized table build; per-byte loop in
+# Python is fine at test scale — the C++ reader owns the fast path).
+_CRC_TABLE = None
+
+
+def _crc_table() -> np.ndarray:
+  global _CRC_TABLE
+  if _CRC_TABLE is None:
+    poly = np.uint32(0x82F63B78)
+    table = np.arange(256, dtype=np.uint32)
+    for _ in range(8):
+      table = np.where(table & 1, (table >> 1) ^ poly, table >> 1)
+    _CRC_TABLE = table
+  return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+  """CRC32C (Castagnoli) of `data`."""
+  table = _crc_table()
+  crc = np.uint32(0xFFFFFFFF)
+  arr = np.frombuffer(data, dtype=np.uint8)
+  for byte in arr:
+    crc = table[(crc ^ byte) & np.uint32(0xFF)] ^ (crc >> np.uint32(8))
+  return int(crc ^ np.uint32(0xFFFFFFFF))
+
+
+def masked_crc32c(data: bytes) -> int:
+  """TF's masked CRC (so CRCs of CRCs don't collide with data CRCs)."""
+  crc = crc32c(data)
+  rotated = ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
+  return (rotated + 0xA282EAD8) & 0xFFFFFFFF
+
+
+class TFRecordWriter:
+  """Writes TFRecord files (data collection, test fixtures, converters)."""
+
+  def __init__(self, path: str):
+    self._file = open(path, "wb")
+
+  def write(self, record: bytes) -> None:
+    length_bytes = struct.pack("<Q", len(record))
+    self._file.write(length_bytes)
+    self._file.write(struct.pack("<I", masked_crc32c(length_bytes)))
+    self._file.write(record)
+    self._file.write(struct.pack("<I", masked_crc32c(record)))
+
+  def flush(self) -> None:
+    self._file.flush()
+
+  def close(self) -> None:
+    self._file.close()
+
+  def __enter__(self) -> "TFRecordWriter":
+    return self
+
+  def __exit__(self, *exc) -> None:
+    self.close()
+
+
+def write_tfrecords(path: str, records: Iterable[bytes]) -> None:
+  with TFRecordWriter(path) as writer:
+    for record in records:
+      writer.write(record)
+
+
+def read_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
+  """Yields records from one TFRecord file.
+
+  CRC verification is on by default (corrupt robot-fleet data should fail
+  loudly, not train silently); the C++ reader keeps the same default.
+  """
+  with open(path, "rb") as f:
+    while True:
+      header = f.read(12)
+      if not header:
+        return
+      if len(header) < 12:
+        raise ValueError(f"{path}: truncated record header")
+      length, length_crc = struct.unpack("<QI", header)
+      if verify_crc and masked_crc32c(header[:8]) != length_crc:
+        raise ValueError(f"{path}: corrupted record length (CRC mismatch)")
+      data = f.read(length)
+      if len(data) < length:
+        raise ValueError(f"{path}: truncated record body")
+      footer = f.read(4)
+      if len(footer) < 4:
+        raise ValueError(f"{path}: truncated record footer")
+      (data_crc,) = struct.unpack("<I", footer)
+      if verify_crc and masked_crc32c(data) != data_crc:
+        raise ValueError(f"{path}: corrupted record data (CRC mismatch)")
+      yield data
+
+
+def list_files(file_patterns: str | Iterable[str]) -> List[str]:
+  """Expands comma-separated glob patterns to a sorted file list.
+
+  Reference: input_generators file_patterns handling (comma-separated
+  globs, e.g. '/data/train-*.tfrecord,/data/extra-*.tfrecord').
+  """
+  import glob as globlib
+
+  if isinstance(file_patterns, str):
+    patterns = [p for p in file_patterns.split(",") if p]
+  else:
+    patterns = list(file_patterns)
+  files: List[str] = []
+  for pattern in patterns:
+    matches = sorted(globlib.glob(os.path.expanduser(pattern)))
+    if not matches and os.path.exists(pattern):
+      matches = [pattern]
+    files.extend(matches)
+  if not files:
+    raise FileNotFoundError(
+        f"No files matched file_patterns={file_patterns!r}")
+  return files

@@ -729,31 +729,30 @@ def tensorspec_to_feature_dict(
     if not isinstance(spec, ExtendedTensorSpec):
       raise ValueError(f"Spec leaf {key!r} is not an ExtendedTensorSpec.")
     feature_name = spec.name or key.rsplit("/", 1)[-1]
-    if feature_name in out:
-      # Two spec paths mapping to one record feature is fine (e.g. MAML's
-      # condition/ and inference/ views of the same episode data) — but only
-      # if they agree on how to parse it.
-      prior = out[feature_name]
-      continue_ok = (prior.shape == spec.shape and prior.dtype == spec.dtype)
-      if not continue_ok:
-        raise ValueError(
-            f"Feature name {feature_name!r} is produced by multiple specs "
-            f"with conflicting schemas: {prior!r} vs spec at {key!r} "
-            f"({spec!r}). Give the specs distinct names."
-        )
-      continue
     if is_encoded_image_spec(spec) and decode_images:
-      out[feature_name] = FeatureSchema(
+      schema = FeatureSchema(
           kind="image", shape=spec.shape, dtype=spec.dtype,
           data_format=spec.data_format)
     elif spec.is_sequence or spec.varlen_default_value is not None:
       default = spec.varlen_default_value
-      out[feature_name] = FeatureSchema(
+      schema = FeatureSchema(
           kind="varlen", shape=spec.shape, dtype=spec.dtype,
           default_value=0.0 if default is None else default)
     else:
-      out[feature_name] = FeatureSchema(
-          kind="fixed", shape=spec.shape, dtype=spec.dtype)
+      schema = FeatureSchema(kind="fixed", shape=spec.shape, dtype=spec.dtype)
+    if feature_name in out:
+      # Two spec paths mapping to one record feature is fine (e.g. MAML's
+      # condition/ and inference/ views of the same episode data) — but only
+      # if they agree on the complete parse rule (kind, shape, dtype,
+      # padding, encoding), not just shape/dtype.
+      if out[feature_name] != schema:
+        raise ValueError(
+            f"Feature name {feature_name!r} is produced by multiple specs "
+            f"with conflicting parse schemas: {out[feature_name]!r} vs "
+            f"{schema!r} (spec at {key!r}). Give the specs distinct names."
+        )
+      continue
+    out[feature_name] = schema
   return out
 
 
