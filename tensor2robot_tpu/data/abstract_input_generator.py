@@ -17,16 +17,13 @@ from __future__ import annotations
 import abc
 from typing import Callable, Iterator, Optional, Tuple
 
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.modes import EVAL, PREDICT, TRAIN  # noqa: F401 (re-export)
 from tensor2robot_tpu.specs import tensorspec_utils as ts
 
 # A batch is (features, labels) — both flat TensorSpecStructs of numpy
 # arrays with a leading (per-host) batch dim.
 Batch = Tuple[ts.TensorSpecStruct, ts.TensorSpecStruct]
-
-TRAIN = "train"
-EVAL = "eval"
-PREDICT = "predict"
-_MODES = (TRAIN, EVAL, PREDICT)
 
 
 class AbstractInputGenerator(abc.ABC):
@@ -111,8 +108,7 @@ class AbstractInputGenerator(abc.ABC):
     continuous-eval can each restart their streams — the analogue of the
     reference's create_dataset_input_fn returning an input_fn.
     """
-    if mode not in _MODES:
-      raise ValueError(f"Unknown mode {mode!r}; expected one of {_MODES}")
+    modes.validate_mode(mode)
     self._assert_specs_set()
 
     def factory() -> Iterator[Batch]:

@@ -1,0 +1,17 @@
+"""Model layer: the portable T2R model abstraction and canonical task heads.
+
+Reference parity: models/ (SURVEY.md §2 "Model interface", "Model base
+classes").
+"""
+
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.models.classification_model import ClassificationModel
+from tensor2robot_tpu.models.critic_model import CriticModel
+from tensor2robot_tpu.models.regression_model import RegressionModel
+
+__all__ = [
+    "AbstractT2RModel",
+    "ClassificationModel",
+    "CriticModel",
+    "RegressionModel",
+]

@@ -1,0 +1,62 @@
+"""ClassificationModel — softmax cross-entropy task head base class.
+
+Reference parity: models/classification_model.py §ClassificationModel
+(SURVEY.md §2 "Model base classes"). The module's outputs must contain
+``logits`` of shape (batch, num_classes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import optax
+
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, Metrics
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class ClassificationModel(AbstractT2RModel):
+  """Softmax classification against integer class labels.
+
+  Args:
+    label_key: flat key of the int class-id tensor in the label spec.
+    output_key: key of the logits in the module outputs.
+  """
+
+  def __init__(self, label_key: str = "label", output_key: str = "logits",
+               **kwargs):
+    super().__init__(**kwargs)
+    self.label_key = label_key
+    self.output_key = output_key
+
+  def loss_fn(
+      self,
+      outputs,
+      features: ts.TensorSpecStruct,
+      labels: Optional[ts.TensorSpecStruct],
+  ) -> Tuple[jnp.ndarray, Metrics]:
+    if labels is None:
+      raise ValueError("ClassificationModel.loss_fn requires labels")
+    logits = outputs[self.output_key].astype(jnp.float32)
+    class_ids = labels[self.label_key]
+    # Dispatch on dtype, not ndim: integer labels of shape (B,) or (B, 1)
+    # are class ids; float labels must be one-hot/soft distributions. An
+    # ndim heuristic would silently broadcast (B,1) int labels into the
+    # one-hot path and optimize garbage.
+    if jnp.issubdtype(class_ids.dtype, jnp.integer):
+      class_ids = class_ids.reshape(logits.shape[:-1])
+      xent = optax.softmax_cross_entropy_with_integer_labels(
+          logits, class_ids).mean()
+      accuracy = jnp.mean(
+          (jnp.argmax(logits, -1) == class_ids).astype(jnp.float32))
+    else:
+      if class_ids.shape != logits.shape:
+        raise ValueError(
+            f"Float labels must be one-hot with shape {logits.shape}, got "
+            f"{class_ids.shape}; integer class ids must use an int dtype.")
+      xent = optax.softmax_cross_entropy(logits, class_ids).mean()
+      accuracy = jnp.mean(
+          (jnp.argmax(logits, -1) == jnp.argmax(class_ids, -1)
+           ).astype(jnp.float32))
+    return xent, {"cross_entropy": xent, "accuracy": accuracy}

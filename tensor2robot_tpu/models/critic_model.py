@@ -1,0 +1,73 @@
+"""CriticModel — (state, action) → scalar Q-value base class.
+
+Reference parity: models/critic_model.py §CriticModel (SURVEY.md §2) — the
+base of the QT-Opt grasping Q-function (research/qtopt). Bellman targets
+arrive as labels (the reference's off-repo Bellman-updater fleet produced
+them; here any replay/conversion pipeline can): the model itself is a pure
+supervised critic.
+
+Loss options mirror the QT-Opt setup: ``cross_entropy`` treats the target as
+a probability-of-success in [0, 1] against a sigmoid Q head (the published
+grasping formulation); ``mse`` is the generic regression critic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, Metrics
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class CriticModel(AbstractT2RModel):
+  """Q(s, a) critic. Module outputs must contain ``q_predicted`` — the
+  pre-sigmoid logit when loss_type='cross_entropy', the raw value for 'mse'.
+
+  Args:
+    target_key: flat key of the Bellman/For-success target in labels.
+    loss_type: 'cross_entropy' (QT-Opt grasping) or 'mse'.
+  """
+
+  def __init__(self, target_key: str = "target_q",
+               loss_type: str = "cross_entropy", **kwargs):
+    if loss_type not in ("cross_entropy", "mse"):
+      raise ValueError(f"Unknown loss_type {loss_type!r}")
+    super().__init__(**kwargs)
+    self.target_key = target_key
+    self.loss_type = loss_type
+
+  def q_value(self, outputs) -> jnp.ndarray:
+    """Q in value space (sigmoid applied for the cross-entropy head)."""
+    q = outputs["q_predicted"]
+    if self.loss_type == "cross_entropy":
+      return jax.nn.sigmoid(q.astype(jnp.float32))
+    return q
+
+  def loss_fn(
+      self,
+      outputs,
+      features: ts.TensorSpecStruct,
+      labels: Optional[ts.TensorSpecStruct],
+  ) -> Tuple[jnp.ndarray, Metrics]:
+    if labels is None:
+      raise ValueError("CriticModel.loss_fn requires labels")
+    q_logit = outputs["q_predicted"].astype(jnp.float32)
+    target = labels[self.target_key].astype(jnp.float32)
+    q_logit = q_logit.reshape(target.shape)
+    if self.loss_type == "cross_entropy":
+      loss = optax.sigmoid_binary_cross_entropy(q_logit, target).mean()
+      q_prob = jax.nn.sigmoid(q_logit)
+      metrics = {
+          "bce": loss,
+          "q_mean": q_prob.mean(),
+          # Grasp-success style accuracy at the 0.5 threshold.
+          "accuracy": jnp.mean(
+              ((q_prob > 0.5) == (target > 0.5)).astype(jnp.float32)),
+      }
+      return loss, metrics
+    loss = jnp.mean(jnp.square(q_logit - target))
+    return loss, {"mse": loss, "q_mean": q_logit.mean()}

@@ -1,0 +1,45 @@
+"""RegressionModel — MSE task head base class.
+
+Reference parity: models/regression_model.py §RegressionModel (SURVEY.md §2
+"Model base classes"). Subclasses declare specs + build_module; the module's
+outputs must contain ``inference_output``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, Metrics
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class RegressionModel(AbstractT2RModel):
+  """MSE regression against a single label tensor.
+
+  Args:
+    label_key: flat key of the regression target in the label spec.
+    output_key: key of the prediction in the module outputs.
+  """
+
+  def __init__(self, label_key: str = "target",
+               output_key: str = "inference_output", **kwargs):
+    super().__init__(**kwargs)
+    self.label_key = label_key
+    self.output_key = output_key
+
+  def loss_fn(
+      self,
+      outputs,
+      features: ts.TensorSpecStruct,
+      labels: Optional[ts.TensorSpecStruct],
+  ) -> Tuple[jnp.ndarray, Metrics]:
+    if labels is None:
+      raise ValueError("RegressionModel.loss_fn requires labels")
+    predictions = outputs[self.output_key]
+    targets = labels[self.label_key].astype(predictions.dtype)
+    error = (predictions - targets).astype(jnp.float32)
+    mse = jnp.mean(jnp.square(error))
+    mae = jnp.mean(jnp.abs(error))
+    return mse, {"mse": mse, "mae": mae}

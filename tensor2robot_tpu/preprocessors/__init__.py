@@ -1,0 +1,31 @@
+"""Preprocessors: declared-spec-in → declared-spec-out transformations.
+
+Reference parity: preprocessors/ (SURVEY.md §2 "Preprocessors"). Host-side
+by design: they run in the input-pipeline threads, so only dense numeric
+statically-shaped arrays ever cross the host→device boundary — the invariant
+the reference enforced with TPUPreprocessorWrapper, which the rebuild gets
+for free (no strings can reach device_put). Device-side augmentation (inside
+the jitted step) lives in tensor2robot_tpu.ops instead.
+"""
+
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+    ModelNoOpPreprocessor,
+    NoOpPreprocessor,
+)
+from tensor2robot_tpu.preprocessors.image_preprocessors import (
+    ImagePreprocessor,
+    apply_photometric_distortions,
+    center_crop,
+    random_crop,
+)
+
+__all__ = [
+    "AbstractPreprocessor",
+    "ImagePreprocessor",
+    "ModelNoOpPreprocessor",
+    "NoOpPreprocessor",
+    "apply_photometric_distortions",
+    "center_crop",
+    "random_crop",
+]

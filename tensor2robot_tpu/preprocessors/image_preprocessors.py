@@ -1,0 +1,191 @@
+"""Image preprocessing: crops, photometric distortion, dtype conversion.
+
+Reference parity: preprocessors/image_transformations.py
+§ApplyPhotometricImageDistortions, §CreateRandomCrop and the
+uint8→float conversion half of §TPUPreprocessorWrapper (SURVEY.md §2).
+
+Host-side numpy, batched, vectorized — runs in the input-pipeline threads so
+the device step stays pure compute. The distortion math matches the
+reference's TF ops: brightness/contrast/saturation jitter in float space,
+applied per-example with an independent host RNG (training only).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+def random_crop(
+    images: np.ndarray,
+    target_height: int,
+    target_width: int,
+    rng: np.random.Generator,
+) -> np.ndarray:
+  """Per-example random spatial crop of a BHWC batch."""
+  b, h, w, _ = images.shape
+  if target_height > h or target_width > w:
+    raise ValueError(
+        f"Crop {target_height}x{target_width} larger than image {h}x{w}")
+  tops = rng.integers(0, h - target_height + 1, size=b)
+  lefts = rng.integers(0, w - target_width + 1, size=b)
+  out = np.empty((b, target_height, target_width, images.shape[3]),
+                 dtype=images.dtype)
+  for i in range(b):
+    out[i] = images[i, tops[i]:tops[i] + target_height,
+                    lefts[i]:lefts[i] + target_width]
+  return out
+
+
+def center_crop(images: np.ndarray, target_height: int,
+                target_width: int) -> np.ndarray:
+  """Deterministic center crop of a BHWC batch (eval counterpart)."""
+  _, h, w, _ = images.shape
+  if target_height > h or target_width > w:
+    raise ValueError(
+        f"Crop {target_height}x{target_width} larger than image {h}x{w}")
+  top = (h - target_height) // 2
+  left = (w - target_width) // 2
+  return images[:, top:top + target_height, left:left + target_width]
+
+
+def apply_photometric_distortions(
+    images: np.ndarray,
+    rng: np.random.Generator,
+    max_brightness_delta: float = 0.125,
+    contrast_range: Tuple[float, float] = (0.5, 1.5),
+    saturation_range: Tuple[float, float] = (0.5, 1.5),
+    noise_stddev: float = 0.0,
+) -> np.ndarray:
+  """Per-example brightness/contrast/saturation jitter on float images.
+
+  Reference: §ApplyPhotometricImageDistortions. Input must be float in
+  [0, 1]; output is clipped back to [0, 1].
+  """
+  if not np.issubdtype(images.dtype, np.floating):
+    raise ValueError(
+        f"Photometric distortions expect float images in [0,1], got "
+        f"{images.dtype}; convert first.")
+  b = images.shape[0]
+  out = images.astype(np.float32, copy=True)
+  # Brightness: additive delta per example.
+  deltas = rng.uniform(-max_brightness_delta, max_brightness_delta,
+                       size=(b, 1, 1, 1)).astype(np.float32)
+  out += deltas
+  # Contrast: scale around the per-example mean.
+  factors = rng.uniform(*contrast_range, size=(b, 1, 1, 1)).astype(np.float32)
+  means = out.mean(axis=(1, 2, 3), keepdims=True)
+  out = (out - means) * factors + means
+  # Saturation: blend with per-pixel grayscale (channel mean).
+  if out.shape[-1] == 3:
+    sat = rng.uniform(*saturation_range, size=(b, 1, 1, 1)).astype(np.float32)
+    gray = out.mean(axis=-1, keepdims=True)
+    out = gray + (out - gray) * sat
+  if noise_stddev > 0.0:
+    out += rng.normal(0.0, noise_stddev, size=out.shape).astype(np.float32)
+  return np.clip(out, 0.0, 1.0)
+
+
+class ImagePreprocessor(AbstractPreprocessor):
+  """Standard camera-image path: decode-sized uint8 in → float model-size out.
+
+  Train: random crop + photometric distortion. Eval/predict: center crop
+  only. Non-image keys pass through unchanged. The uint8→float32 [0,1]
+  conversion is the reference's TPUPreprocessorWrapper dtype rule.
+
+  Args:
+    feature_spec: model-facing (out) feature specs; the image key must be a
+      float spec with shape (H, W, C).
+    label_spec: passthrough label specs.
+    image_key: flat key of the image feature.
+    in_image_shape: the parsed (pre-crop) image shape; defaults to the out
+      shape (no crop).
+    distort: enable photometric distortion in train mode.
+    seed: augmentation seed. Pass a per-host-distinct value (e.g.
+      seed + shard_index) in multi-host training so hosts don't apply
+      identical crop sequences.
+  """
+
+  def __init__(
+      self,
+      feature_spec: ts.SpecStructure,
+      label_spec: Optional[ts.SpecStructure] = None,
+      image_key: str = "image",
+      in_image_shape: Optional[Sequence[int]] = None,
+      data_format: str = "jpeg",
+      distort: bool = True,
+      seed: int = 0,
+  ):
+    self._out_feature_spec = ts.flatten_spec_structure(feature_spec)
+    if image_key not in self._out_feature_spec:
+      raise ValueError(
+          f"image_key {image_key!r} not in feature spec: "
+          f"{list(self._out_feature_spec)}")
+    self._image_key = image_key
+    out_image = self._out_feature_spec[image_key]
+    if not np.issubdtype(out_image.dtype, np.floating):
+      raise ValueError(
+          f"Out image spec must be float (model-ready), got "
+          f"{out_image.dtype}")
+    in_shape = tuple(in_image_shape) if in_image_shape else out_image.shape
+    # In-spec: parsed as encoded uint8 image at the pre-crop size.
+    self._in_feature_spec = ts.TensorSpecStruct(self._out_feature_spec)
+    self._in_feature_spec[image_key] = ts.ExtendedTensorSpec(
+        in_shape, np.uint8, name=out_image.name or image_key,
+        data_format=data_format)
+    self._label_spec = (
+        ts.flatten_spec_structure(label_spec) if label_spec is not None
+        else ts.TensorSpecStruct())
+    self._distort = distort
+    # Preprocessors run on the input pipeline's thread pool;
+    # np.random.Generator is not thread-safe, so each thread gets its own
+    # stream: (seed, stream-index) with the index handed out atomically.
+    self._seed = seed
+    self._stream_counter = itertools.count()
+    self._local = threading.local()
+
+  @property
+  def _rng(self) -> np.random.Generator:
+    rng = getattr(self._local, "rng", None)
+    if rng is None:
+      rng = np.random.default_rng([self._seed, next(self._stream_counter)])
+      self._local.rng = rng
+    return rng
+
+  def get_in_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
+    return self._in_feature_spec
+
+  def get_in_label_specification(self, mode: str) -> ts.TensorSpecStruct:
+    return self._label_spec
+
+  def get_out_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
+    return self._out_feature_spec
+
+  def get_out_label_specification(self, mode: str) -> ts.TensorSpecStruct:
+    return self._label_spec
+
+  def _preprocess_fn(self, features, labels, mode):
+    out = ts.TensorSpecStruct(features)
+    images = np.asarray(features[self._image_key])
+    target_h, target_w = self._out_feature_spec[self._image_key].shape[:2]
+    images = images.astype(np.float32) / 255.0
+    if mode == modes.TRAIN:
+      if images.shape[1:3] != (target_h, target_w):
+        images = random_crop(images, target_h, target_w, self._rng)
+      if self._distort:
+        images = apply_photometric_distortions(images, self._rng)
+    else:
+      if images.shape[1:3] != (target_h, target_w):
+        images = center_crop(images, target_h, target_w)
+    out[self._image_key] = images.astype(
+        self._out_feature_spec[self._image_key].dtype)
+    return out, labels

@@ -1,0 +1,1 @@
+"""Utilities: mocks, test fixtures, config system, schedules."""

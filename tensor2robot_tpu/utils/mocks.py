@@ -1,0 +1,67 @@
+"""Mock full-stack components — the framework's core testing idea.
+
+Reference parity: utils/mocks.py §MockT2RModel, §MockPreprocessor
+(SURVEY.md §4): a tiny real model over synthetic specs, so the *actual*
+train loop / export / predictor machinery runs end-to-end in-process with no
+data files and no accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.models.regression_model import RegressionModel
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class MockModule(nn.Module):
+  """Tiny MLP: x:(3,) → target:(1,), with dropout + batch norm so the mock
+  exercises rng threading and mutable-collection plumbing."""
+
+  hidden_size: int = 16
+  use_batch_norm: bool = False
+  compute_dtype: type = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, features, mode: str):
+    train = mode == modes.TRAIN
+    x = features["x"].astype(self.compute_dtype)
+    x = nn.Dense(self.hidden_size, dtype=self.compute_dtype)(x)
+    if self.use_batch_norm:
+      x = nn.BatchNorm(use_running_average=not train,
+                       dtype=self.compute_dtype)(x)
+    x = nn.relu(x)
+    x = nn.Dropout(rate=0.1, deterministic=not train)(x)
+    out = nn.Dense(1, dtype=jnp.float32)(x)
+    return ts.TensorSpecStruct({"inference_output": out})
+
+
+class MockT2RModel(RegressionModel):
+  """The reference's MockT2RModel: trains in milliseconds, exercises the
+  whole stack (specs → data → module → loss → optimizer → export)."""
+
+  def __init__(self, hidden_size: int = 16, use_batch_norm: bool = False,
+               **kwargs):
+    super().__init__(**kwargs)
+    self.hidden_size = hidden_size
+    self.use_batch_norm = use_batch_norm
+
+  def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return ts.TensorSpecStruct(
+        {"x": ts.ExtendedTensorSpec((3,), np.float32, name="x")})
+
+  def get_label_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return ts.TensorSpecStruct(
+        {"target": ts.ExtendedTensorSpec((1,), np.float32, name="target")})
+
+  def build_module(self) -> nn.Module:
+    return MockModule(hidden_size=self.hidden_size,
+                      use_batch_norm=self.use_batch_norm,
+                      compute_dtype=self.compute_dtype)

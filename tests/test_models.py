@@ -1,0 +1,335 @@
+"""Tests for the model core and preprocessors.
+
+Reference test parity: models/abstract_model_test.py, preprocessors/*_test.py
+(SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.models.classification_model import ClassificationModel
+from tensor2robot_tpu.models.critic_model import CriticModel
+from tensor2robot_tpu.preprocessors import (
+    ImagePreprocessor,
+    NoOpPreprocessor,
+    apply_photometric_distortions,
+    center_crop,
+    random_crop,
+)
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+import flax.linen as nn
+
+
+class TestMockModelCore:
+
+  def test_init_variables_from_specs(self):
+    model = MockT2RModel()
+    variables = model.init_variables(jax.random.key(0), batch_size=2)
+    assert "params" in variables
+    shapes = jax.tree_util.tree_map(lambda p: p.shape, variables["params"])
+    assert shapes["Dense_0"]["kernel"] == (3, 16)
+
+  def test_train_fn_loss_and_metrics(self):
+    model = MockT2RModel()
+    variables = model.init_variables(jax.random.key(0))
+    batch = ts.make_random_batch(model.get_feature_specification("train"), 4)
+    labels = ts.make_random_batch(model.get_label_specification("train"), 4)
+    loss, (metrics, new_state) = model.model_train_fn(
+        variables, batch, labels, rngs={"dropout": jax.random.key(1)})
+    assert loss.shape == ()
+    assert set(metrics) >= {"mse", "mae", "loss"}
+    assert new_state == {}
+
+  def test_batch_norm_state_threads(self):
+    model = MockT2RModel(use_batch_norm=True)
+    variables = model.init_variables(jax.random.key(0), batch_size=4)
+    assert "batch_stats" in variables
+    batch = ts.make_random_batch(model.get_feature_specification("train"), 4)
+    labels = ts.make_random_batch(model.get_label_specification("train"), 4)
+    _, (_, new_state) = model.model_train_fn(
+        variables, batch, labels, rngs={"dropout": jax.random.key(1)})
+    assert "batch_stats" in new_state
+    before = variables["batch_stats"]["BatchNorm_0"]["mean"]
+    after = new_state["batch_stats"]["BatchNorm_0"]["mean"]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+
+  def test_grad_through_train_fn(self):
+    model = MockT2RModel()
+    variables = model.init_variables(jax.random.key(0))
+    batch = ts.make_random_batch(model.get_feature_specification("train"), 8)
+    labels = ts.make_random_batch(model.get_label_specification("train"), 8)
+
+    def loss_of_params(params):
+      loss, _ = model.model_train_fn(
+          {"params": params}, batch, labels,
+          rngs={"dropout": jax.random.key(1)})
+      return loss
+
+    grads = jax.grad(loss_of_params)(variables["params"])
+    norms = jax.tree_util.tree_map(lambda g: float(jnp.abs(g).sum()), grads)
+    total = sum(jax.tree_util.tree_leaves(norms))
+    assert total > 0.0
+
+  def test_training_reduces_loss(self):
+    model = MockT2RModel(optimizer_fn=lambda: optax.adam(1e-2))
+    variables = model.init_variables(jax.random.key(0))
+    params = variables["params"]
+    tx = model.create_optimizer()
+    opt_state = tx.init(params)
+    rng = np.random.default_rng(0)
+    x = rng.random((64, 3)).astype(np.float32)
+    target = (x.sum(-1, keepdims=True) * 0.5).astype(np.float32)
+    batch = TensorSpecStruct({"x": jnp.asarray(x)})
+    labels = TensorSpecStruct({"target": jnp.asarray(target)})
+
+    @jax.jit
+    def step(params, opt_state, key):
+      def loss_fn(p):
+        loss, _ = model.model_train_fn({"params": p}, batch, labels,
+                                       rngs={"dropout": key})
+        return loss
+      loss, grads = jax.value_and_grad(loss_fn)(params)
+      updates, opt_state = tx.update(grads, opt_state, params)
+      return optax.apply_updates(params, updates), opt_state, loss
+
+    key = jax.random.key(42)
+    first = None
+    for i in range(60):
+      key, sub = jax.random.split(key)
+      params, opt_state, loss = step(params, opt_state, sub)
+      if first is None:
+        first = float(loss)
+    assert float(loss) < first * 0.7
+
+  def test_eval_fn(self):
+    model = MockT2RModel()
+    variables = model.init_variables(jax.random.key(0))
+    batch = ts.make_random_batch(model.get_feature_specification("eval"), 4)
+    labels = ts.make_random_batch(model.get_label_specification("eval"), 4)
+    metrics = model.model_eval_fn(variables, batch, labels)
+    assert "mse" in metrics and "loss" in metrics
+
+  def test_predict_fn(self):
+    model = MockT2RModel()
+    variables = model.init_variables(jax.random.key(0))
+    batch = ts.make_random_batch(model.get_feature_specification("predict"), 4)
+    outputs = model.predict_fn(variables, batch)
+    assert outputs["inference_output"].shape == (4, 1)
+
+  def test_custom_optimizer_fn(self):
+    model = MockT2RModel(optimizer_fn=lambda: optax.sgd(0.1))
+    tx = model.create_optimizer()
+    assert isinstance(tx, optax.GradientTransformation)
+
+
+class _TinyClassifier(ClassificationModel):
+
+  def get_feature_specification(self, mode):
+    return {"x": ExtendedTensorSpec((4,), np.float32, name="x")}
+
+  def get_label_specification(self, mode):
+    return {"label": ExtendedTensorSpec((), np.int32, name="label")}
+
+  def build_module(self):
+    class M(nn.Module):
+      @nn.compact
+      def __call__(self, features, mode):
+        return {"logits": nn.Dense(3)(features["x"])}
+    return M()
+
+
+class _TinyCritic(CriticModel):
+
+  def get_feature_specification(self, mode):
+    return {
+        "state": ExtendedTensorSpec((4,), np.float32, name="state"),
+        "action": ExtendedTensorSpec((2,), np.float32, name="action"),
+    }
+
+  def get_label_specification(self, mode):
+    return {"target_q": ExtendedTensorSpec((), np.float32, name="target_q")}
+
+  def build_module(self):
+    class M(nn.Module):
+      @nn.compact
+      def __call__(self, features, mode):
+        x = jnp.concatenate([features["state"], features["action"]], -1)
+        return {"q_predicted": nn.Dense(1)(x)[:, 0]}
+    return M()
+
+
+class TestTaskHeads:
+
+  def test_classification_integer_labels(self):
+    model = _TinyClassifier()
+    variables = model.init_variables(jax.random.key(0))
+    features = ts.make_random_batch(model.get_feature_specification("train"), 6)
+    labels = TensorSpecStruct({"label": jnp.array([0, 1, 2, 0, 1, 2],
+                                                  jnp.int32)})
+    loss, (metrics, _) = model.model_train_fn(variables, features, labels)
+    assert loss.shape == ()
+    assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+  def test_classification_trailing_unit_dim_int_labels(self):
+    # (B, 1) integer labels must hit the integer path, not broadcast into
+    # the one-hot loss.
+    model = _TinyClassifier()
+    variables = model.init_variables(jax.random.key(0))
+    features = ts.make_random_batch(model.get_feature_specification("train"), 4)
+    flat_labels = TensorSpecStruct({"label": jnp.array([0, 1, 2, 0],
+                                                       jnp.int32)})
+    col_labels = TensorSpecStruct({"label": jnp.array([[0], [1], [2], [0]],
+                                                      jnp.int32)})
+    loss_flat, _ = model.model_train_fn(variables, features, flat_labels)
+    loss_col, _ = model.model_train_fn(variables, features, col_labels)
+    assert float(loss_flat) == pytest.approx(float(loss_col))
+
+  def test_classification_bad_float_labels_raise(self):
+    model = _TinyClassifier()
+    variables = model.init_variables(jax.random.key(0))
+    features = ts.make_random_batch(model.get_feature_specification("train"), 4)
+    labels = TensorSpecStruct({"label": jnp.zeros((4, 1), jnp.float32)})
+    with pytest.raises(ValueError, match="one-hot"):
+      model.model_train_fn(variables, features, labels)
+
+  def test_classification_onehot_labels(self):
+    model = _TinyClassifier()
+    variables = model.init_variables(jax.random.key(0))
+    features = ts.make_random_batch(model.get_feature_specification("train"), 4)
+    onehot = jnp.eye(3)[jnp.array([0, 1, 2, 0])]
+    labels = TensorSpecStruct({"label": onehot})
+    loss, (metrics, _) = model.model_train_fn(variables, features, labels)
+    assert float(loss) > 0
+
+  def test_critic_cross_entropy(self):
+    model = _TinyCritic(loss_type="cross_entropy")
+    variables = model.init_variables(jax.random.key(0))
+    features = ts.make_random_batch(model.get_feature_specification("train"), 5)
+    labels = TensorSpecStruct(
+        {"target_q": jnp.array([0.0, 1.0, 0.5, 1.0, 0.0])})
+    loss, (metrics, _) = model.model_train_fn(variables, features, labels)
+    assert set(metrics) >= {"bce", "q_mean", "accuracy"}
+    outputs = model.predict_fn(variables, features)
+    q = model.q_value(outputs)
+    assert ((np.asarray(q) >= 0) & (np.asarray(q) <= 1)).all()
+
+  def test_critic_mse(self):
+    model = _TinyCritic(loss_type="mse")
+    variables = model.init_variables(jax.random.key(0))
+    features = ts.make_random_batch(model.get_feature_specification("train"), 5)
+    labels = TensorSpecStruct({"target_q": jnp.arange(5.0)})
+    loss, (metrics, _) = model.model_train_fn(variables, features, labels)
+    assert "mse" in metrics
+
+  def test_critic_bad_loss_type(self):
+    with pytest.raises(ValueError, match="loss_type"):
+      _TinyCritic(loss_type="huber")
+
+
+class TestPreprocessors:
+
+  def test_noop_round_trip(self):
+    model = MockT2RModel()
+    pre = model.preprocessor
+    from tensor2robot_tpu.preprocessors import ModelNoOpPreprocessor
+    assert isinstance(pre, ModelNoOpPreprocessor)
+    features = ts.make_random_batch(model.get_feature_specification("train"), 4)
+    labels = ts.make_random_batch(model.get_label_specification("train"), 4)
+    out_f, out_l = pre.preprocess(features, labels, modes.TRAIN)
+    np.testing.assert_array_equal(out_f["x"], features["x"])
+
+  def test_default_preprocessor_resolves_specs_per_mode(self):
+    class ModeDependentModel(MockT2RModel):
+      def get_feature_specification(self, mode):
+        spec = TensorSpecStruct(
+            {"x": ExtendedTensorSpec((3,), np.float32, name="x")})
+        if mode == modes.TRAIN:
+          spec["train_only"] = ExtendedTensorSpec((1,), np.float32)
+        return spec
+
+    model = ModeDependentModel()
+    pre = model.preprocessor
+    assert "train_only" in pre.get_in_feature_specification(modes.TRAIN)
+    assert "train_only" not in pre.get_in_feature_specification(modes.PREDICT)
+    # A predict batch without train_only validates fine.
+    batch = TensorSpecStruct({"x": np.zeros((2, 3), np.float32)})
+    pre.preprocess(batch, None, modes.PREDICT)
+    with pytest.raises(ValueError, match="train_only"):
+      pre.preprocess(batch, None, modes.TRAIN)
+
+  def test_image_preprocessor_rng_thread_safety(self):
+    import concurrent.futures
+    out_spec = {"image": ExtendedTensorSpec((8, 8, 3), np.float32,
+                                            name="image")}
+    pre = ImagePreprocessor(out_spec, in_image_shape=(10, 10, 3), seed=0)
+    batch = TensorSpecStruct({
+        "image": np.random.default_rng(0).integers(
+            0, 255, (4, 10, 10, 3)).astype(np.uint8)})
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+      results = list(pool.map(
+          lambda _: pre.preprocess(batch, None, modes.TRAIN)[0]["image"],
+          range(32)))
+    assert all(r.shape == (4, 8, 8, 3) for r in results)
+
+  def test_noop_validates(self):
+    pre = NoOpPreprocessor({"x": ExtendedTensorSpec((3,), np.float32)})
+    with pytest.raises(ValueError):
+      pre.preprocess(TensorSpecStruct({"x": np.zeros((4, 5), np.float32)}),
+                     None, modes.TRAIN)
+
+  def test_crops(self):
+    rng = np.random.default_rng(0)
+    images = rng.random((4, 10, 12, 3)).astype(np.float32)
+    cropped = random_crop(images, 8, 8, rng)
+    assert cropped.shape == (4, 8, 8, 3)
+    centered = center_crop(images, 8, 8)
+    np.testing.assert_array_equal(centered, images[:, 1:9, 2:10])
+    with pytest.raises(ValueError):
+      random_crop(images, 20, 8, rng)
+
+  def test_photometric_distortions(self):
+    rng = np.random.default_rng(0)
+    images = np.full((2, 6, 6, 3), 0.5, np.float32)
+    out = apply_photometric_distortions(images, rng)
+    assert out.shape == images.shape
+    assert out.min() >= 0.0 and out.max() <= 1.0
+    assert not np.allclose(out, images)
+    with pytest.raises(ValueError, match="float"):
+      apply_photometric_distortions(
+          np.zeros((1, 4, 4, 3), np.uint8), rng)
+
+  def test_image_preprocessor_train_vs_eval(self):
+    out_spec = {
+        "image": ExtendedTensorSpec((8, 8, 3), np.float32, name="image"),
+        "pose": ExtendedTensorSpec((2,), np.float32, name="pose"),
+    }
+    pre = ImagePreprocessor(out_spec, in_image_shape=(10, 10, 3),
+                            distort=True, seed=0)
+    in_spec = pre.get_in_feature_specification(modes.TRAIN)
+    assert in_spec["image"].dtype == np.dtype("uint8")
+    assert in_spec["image"].shape == (10, 10, 3)
+    assert ts.is_encoded_image_spec(in_spec["image"])
+    batch = TensorSpecStruct({
+        "image": np.random.default_rng(0).integers(
+            0, 255, (4, 10, 10, 3)).astype(np.uint8),
+        "pose": np.zeros((4, 2), np.float32),
+    })
+    out_train, _ = pre.preprocess(batch, None, modes.TRAIN)
+    assert out_train["image"].shape == (4, 8, 8, 3)
+    assert out_train["image"].dtype == np.float32
+    out_eval, _ = pre.preprocess(batch, None, modes.EVAL)
+    # Eval is deterministic center crop.
+    out_eval2, _ = pre.preprocess(batch, None, modes.EVAL)
+    np.testing.assert_array_equal(out_eval["image"], out_eval2["image"])
+
+  def test_image_preprocessor_requires_float_out(self):
+    with pytest.raises(ValueError, match="float"):
+      ImagePreprocessor(
+          {"image": ExtendedTensorSpec((8, 8, 3), np.uint8, name="image")})
