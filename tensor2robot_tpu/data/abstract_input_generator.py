@@ -46,6 +46,7 @@ class AbstractInputGenerator(abc.ABC):
     self._feature_spec: Optional[ts.TensorSpecStruct] = None
     self._label_spec: Optional[ts.TensorSpecStruct] = None
     self._preprocess_fn: Optional[Callable[..., Batch]] = None
+    self._wired_mode: Optional[str] = None
 
   # --- spec wiring (reference §set_specification_from_model) --------------
 
@@ -64,6 +65,7 @@ class AbstractInputGenerator(abc.ABC):
     )
     self._preprocess_fn = lambda features, labels: preprocessor.preprocess(
         features, labels, mode)
+    self._wired_mode = mode
 
   def set_specification(
       self,
@@ -110,6 +112,12 @@ class AbstractInputGenerator(abc.ABC):
     """
     modes.validate_mode(mode)
     self._assert_specs_set()
+    if self._preprocess_fn is not None and mode != self._wired_mode:
+      raise ValueError(
+          f"Input generator was wired for mode {self._wired_mode!r} (its "
+          f"preprocess closure is mode-bound) but asked to produce "
+          f"{mode!r}; call set_specification_from_model(model, {mode!r}) "
+          "first.")
 
     def factory() -> Iterator[Batch]:
       iterator = self._create_iterator(mode)

@@ -1,0 +1,24 @@
+"""Device-mesh parallelism: the framework's single distribution abstraction.
+
+Reference parity: SURVEY.md §2 "DP / comms backend" rows and §5.8. The
+reference had two sibling backends (TPU CrossShardOptimizer over ICI;
+fork-side NCCL MirroredStrategy). The rebuild has exactly one: a
+`jax.sharding.Mesh` plus NamedSharding annotations — XLA inserts the
+collectives (psum over ICI within a slice, DCN across slices).
+"""
+
+from tensor2robot_tpu.parallel.mesh import (
+    create_mesh,
+    batch_sharding,
+    replicated_sharding,
+    shard_batch,
+    local_batch_slice,
+)
+
+__all__ = [
+    "create_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "shard_batch",
+    "local_batch_slice",
+]

@@ -1,0 +1,109 @@
+"""Mesh construction and sharding helpers.
+
+Reference parity: replaces utils/train_eval.py §TPUConfig(num_shards) +
+models/abstract_model.py §CrossShardOptimizer (SURVEY.md §2 "DP / comms
+backend (upstream)") and the fork's NCCL MirroredStrategy. One mesh, any
+number of named axes; data parallelism is the `data` axis, and tensor/
+sequence parallelism slot in as extra axes without touching the trainer
+(SURVEY.md §5.8 rebuild stance).
+
+Design notes (TPU-first):
+  - The mesh is created over `jax.devices()` in row-major order; on a real
+    pod slice this matches ICI topology well enough for DP (all-reduce is
+    topology-agnostic under XLA's collective scheduler). Model axes should
+    be innermost (fastest-varying) so TP collectives ride the shortest ICI
+    links — `create_mesh` therefore puts `data` outermost by convention.
+  - Multi-host: `shard_batch` uses
+    `jax.make_array_from_process_local_data`, so each host feeds only its
+    local shard (per-host input pipelines, reference's per-host input_fn).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Any, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def create_mesh(
+    axes: Optional[Mapping[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+  """Creates a named device mesh.
+
+  Args:
+    axes: ordered {axis_name: size}; at most one size may be -1 (fill with
+      all remaining devices). Default: {"data": -1} — pure DP, the
+      reference's only strategy.
+    devices: devices to mesh over; default jax.devices().
+
+  Returns:
+    jax.sharding.Mesh with the requested axes.
+  """
+  if devices is None:
+    devices = jax.devices()
+  devices = list(devices)
+  if axes is None:
+    axes = {"data": -1}
+  axes = collections.OrderedDict(axes)
+  fill_axes = [name for name, size in axes.items() if size == -1]
+  if len(fill_axes) > 1:
+    raise ValueError(f"At most one axis may be -1, got {fill_axes}.")
+  fixed = math.prod(size for size in axes.values() if size != -1)
+  if len(devices) % fixed != 0:
+    raise ValueError(
+        f"Device count {len(devices)} not divisible by fixed axes {axes}.")
+  if fill_axes:
+    axes[fill_axes[0]] = len(devices) // fixed
+  total = math.prod(axes.values())
+  if total != len(devices):
+    raise ValueError(
+        f"Mesh axes {dict(axes)} require {total} devices, have"
+        f" {len(devices)}.")
+  mesh_devices = np.asarray(devices).reshape(tuple(axes.values()))
+  return Mesh(mesh_devices, tuple(axes.keys()))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+  """Sharding for batched arrays: leading dim split over `axis`."""
+  return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+  """Fully-replicated sharding (params, opt state under pure DP)."""
+  return NamedSharding(mesh, PartitionSpec())
+
+
+def local_batch_slice(global_batch_size: int) -> int:
+  """Per-process batch size for a per-host input pipeline.
+
+  Reference parity: TPUEstimator's per-host input_fn sharding
+  (params['batch_size'] = global / num_hosts), SURVEY.md §3.1.
+  """
+  if global_batch_size % jax.process_count() != 0:
+    raise ValueError(
+        f"Global batch {global_batch_size} not divisible by process count"
+        f" {jax.process_count()}.")
+  return global_batch_size // jax.process_count()
+
+
+def shard_batch(mesh: Mesh, batch: Any, axis: str = "data") -> Any:
+  """Places a host-local batch pytree onto the mesh, sharded over `axis`.
+
+  Single-process: a plain sharded device_put. Multi-process: each host
+  contributes its local slice of the global batch
+  (jax.make_array_from_process_local_data assembles the global logical
+  array) — the host→device boundary of SURVEY.md §3.1 without infeed
+  queues.
+  """
+  sharding = batch_sharding(mesh, axis)
+  if jax.process_count() == 1:
+    return jax.device_put(batch, sharding)
+  return jax.tree_util.tree_map(
+      lambda x: jax.make_array_from_process_local_data(
+          sharding, np.asarray(x)),
+      batch)

@@ -58,6 +58,22 @@ def center_crop(images: np.ndarray, target_height: int,
   return images[:, top:top + target_height, left:left + target_width]
 
 
+def adjust_saturation(images: np.ndarray, factors: np.ndarray) -> np.ndarray:
+  """Exact HSV saturation scaling on RGB, vectorized (no HSV round-trip).
+
+  For fixed hue/value each channel is c_i = v·(1 − s·q_i), so scaling
+  s→k·s is c_i' = v − k·(v − c_i), with k capped per-pixel where k·s would
+  exceed 1 — identical to tf.image.adjust_saturation's convert→scale→clip.
+  """
+  v = images.max(axis=-1, keepdims=True)
+  diff = v - images
+  max_diff = diff.max(axis=-1, keepdims=True)
+  with np.errstate(divide="ignore", invalid="ignore"):
+    cap = np.where(max_diff > 0, v / max_diff, np.inf)
+  k = np.minimum(factors, cap)
+  return v - k * diff
+
+
 def apply_photometric_distortions(
     images: np.ndarray,
     rng: np.random.Generator,
@@ -65,34 +81,40 @@ def apply_photometric_distortions(
     contrast_range: Tuple[float, float] = (0.5, 1.5),
     saturation_range: Tuple[float, float] = (0.5, 1.5),
     noise_stddev: float = 0.0,
+    copy: bool = True,
 ) -> np.ndarray:
   """Per-example brightness/contrast/saturation jitter on float images.
 
   Reference: §ApplyPhotometricImageDistortions. Input must be float in
-  [0, 1]; output is clipped back to [0, 1].
+  [0, 1]; output is clipped back to [0, 1]. Contrast scales around the
+  per-channel mean and saturation scales HSV S — matching
+  tf.image.adjust_contrast / adjust_saturation (verified against TF in
+  tests). `copy=False` mutates `images` in place (input-pipeline hot path).
   """
   if not np.issubdtype(images.dtype, np.floating):
     raise ValueError(
         f"Photometric distortions expect float images in [0,1], got "
         f"{images.dtype}; convert first.")
   b = images.shape[0]
-  out = images.astype(np.float32, copy=True)
+  out = images.astype(np.float32, copy=copy)
+  # Saturation first (on the undistorted colors), as HSV math assumes
+  # in-gamut RGB.
+  if out.shape[-1] == 3:
+    sat = rng.uniform(*saturation_range, size=(b, 1, 1, 1)).astype(np.float32)
+    out = adjust_saturation(out, sat)
   # Brightness: additive delta per example.
   deltas = rng.uniform(-max_brightness_delta, max_brightness_delta,
                        size=(b, 1, 1, 1)).astype(np.float32)
   out += deltas
-  # Contrast: scale around the per-example mean.
+  # Contrast: scale around the per-example, per-channel mean.
   factors = rng.uniform(*contrast_range, size=(b, 1, 1, 1)).astype(np.float32)
-  means = out.mean(axis=(1, 2, 3), keepdims=True)
-  out = (out - means) * factors + means
-  # Saturation: blend with per-pixel grayscale (channel mean).
-  if out.shape[-1] == 3:
-    sat = rng.uniform(*saturation_range, size=(b, 1, 1, 1)).astype(np.float32)
-    gray = out.mean(axis=-1, keepdims=True)
-    out = gray + (out - gray) * sat
+  means = out.mean(axis=(1, 2), keepdims=True)
+  out -= means
+  out *= factors
+  out += means
   if noise_stddev > 0.0:
     out += rng.normal(0.0, noise_stddev, size=out.shape).astype(np.float32)
-  return np.clip(out, 0.0, 1.0)
+  return np.clip(out, 0.0, 1.0, out=out)
 
 
 class ImagePreprocessor(AbstractPreprocessor):
@@ -177,15 +199,18 @@ class ImagePreprocessor(AbstractPreprocessor):
     out = ts.TensorSpecStruct(features)
     images = np.asarray(features[self._image_key])
     target_h, target_w = self._out_feature_spec[self._image_key].shape[:2]
-    images = images.astype(np.float32) / 255.0
+    # Crop on uint8 first: converting the full pre-crop batch to float32
+    # would waste host bandwidth in the pipeline threads.
     if mode == modes.TRAIN:
       if images.shape[1:3] != (target_h, target_w):
         images = random_crop(images, target_h, target_w, self._rng)
+      images = images.astype(np.float32) / 255.0
       if self._distort:
-        images = apply_photometric_distortions(images, self._rng)
+        images = apply_photometric_distortions(images, self._rng, copy=False)
     else:
       if images.shape[1:3] != (target_h, target_w):
         images = center_crop(images, target_h, target_w)
+      images = images.astype(np.float32) / 255.0
     out[self._image_key] = images.astype(
-        self._out_feature_spec[self._image_key].dtype)
+        self._out_feature_spec[self._image_key].dtype, copy=False)
     return out, labels

@@ -1,0 +1,13 @@
+"""Training: the pjit'd step + host loop replacing the Estimator.
+
+Reference parity: utils/train_eval.py + the model_fn glue of
+models/abstract_model.py (SURVEY.md §3.1). The Estimator's
+trace-once/compile-once property is jax.jit; infeed is device_put with a
+sharded batch; CrossShardOptimizer is the mesh.
+"""
+
+from tensor2robot_tpu.train.train_state import TrainState
+from tensor2robot_tpu.train.trainer import Trainer
+from tensor2robot_tpu.train.checkpoints import CheckpointManager
+
+__all__ = ["TrainState", "Trainer", "CheckpointManager"]

@@ -1,0 +1,36 @@
+"""TrainState: the complete, checkpointable training pytree.
+
+Reference parity: the union of what tf.train.Saver persisted for an
+Estimator run — global_step, model variables, optimizer slots, EMA
+shadow variables when use_avg_model_params (SURVEY.md §5.4) — as one
+frozen pytree the pjit'd step maps over.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.struct
+import jax.numpy as jnp
+import optax
+
+
+class TrainState(flax.struct.PyTreeNode):
+  """All mutable training state, as a single donated pytree."""
+
+  step: jnp.ndarray                      # scalar int32 global step
+  params: Any                            # master weights (param_dtype)
+  model_state: Dict[str, Any]            # mutable collections (batch_stats)
+  opt_state: optax.OptState
+  ema_params: Optional[Any] = None       # Polyak copy; None unless enabled
+
+  @property
+  def eval_params(self) -> Any:
+    """Params eval/export should use (EMA swap, reference
+    §use_avg_model_params semantics)."""
+    return self.ema_params if self.ema_params is not None else self.params
+
+  def variables(self, use_ema: bool = False) -> Dict[str, Any]:
+    """Reassembles the flax variables dict for module.apply."""
+    params = self.eval_params if use_ema else self.params
+    return {"params": params, **self.model_state}

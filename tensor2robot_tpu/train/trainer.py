@@ -1,0 +1,172 @@
+"""Trainer: builds the jit-compiled, mesh-sharded train/eval steps.
+
+Reference parity: the device-side path of SURVEY.md §3.1 —
+models/abstract_model.py §model_fn(TRAIN) + §create_train_op +
+CrossShardOptimizer — rebuilt as one functional step:
+
+    (state, batch) -> (state', metrics)
+
+traced once, compiled by XLA for the whole mesh. Gradient all-reduce is
+not written anywhere: the batch is sharded over the `data` axis, params
+are replicated, so XLA inserts the psum over ICI where the reference
+called cross_replica_sum.
+
+TPU notes:
+  - The state pytree is donated — params/opt-state buffers are updated in
+    place in HBM, no per-step reallocation.
+  - RNG is folded from a base key and the step counter inside the compiled
+    step, so resuming from a checkpoint replays the identical randomness
+    stream without any host-side key threading.
+  - EMA (use_avg_model_params) runs inside the same fused step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.train.train_state import TrainState
+
+
+class Trainer:
+  """Owns mesh, optimizer, and the compiled step functions for one model."""
+
+  def __init__(
+      self,
+      model,
+      mesh: Optional[jax.sharding.Mesh] = None,
+      seed: int = 0,
+      data_axis: str = "data",
+  ):
+    self.model = model
+    self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
+    self.data_axis = data_axis
+    self._base_rng = jax.random.key(seed)
+    self._optimizer = model.create_optimizer()
+    self._batch_sharding = mesh_lib.batch_sharding(self.mesh, data_axis)
+    self._replicated = mesh_lib.replicated_sharding(self.mesh)
+    self._train_step = None
+    self._eval_step = None
+
+  # --- state ---------------------------------------------------------------
+
+  def create_train_state(self, batch_size: int = 1) -> TrainState:
+    """Initializes (or re-initializes) replicated training state."""
+    def _init(rng: jax.Array) -> TrainState:
+      variables = self.model.init_variables(rng, batch_size=batch_size)
+      variables = dict(variables)
+      params = variables.pop("params")
+      ema = (jax.tree_util.tree_map(jnp.copy, params)
+             if self.model.use_avg_model_params else None)
+      return TrainState(
+          step=jnp.zeros((), jnp.int32),
+          params=params,
+          model_state=variables,
+          opt_state=self._optimizer.init(params),
+          ema_params=ema)
+
+    init = jax.jit(_init, out_shardings=self._replicated)
+    state = init(self._base_rng)
+    if self.model.init_from_checkpoint:
+      state = self._warm_start(state, self.model.init_from_checkpoint)
+    return state
+
+  def _warm_start(self, state: TrainState, checkpoint_path: str) -> TrainState:
+    """Reference §init_from_checkpoint: load matching params by name."""
+    from tensor2robot_tpu.train import checkpoints
+    restored = checkpoints.restore_params(checkpoint_path)
+    params = checkpoints.merge_params(state.params, restored)
+    params = jax.device_put(params, self._replicated)
+    return state.replace(params=params)
+
+  # --- steps ---------------------------------------------------------------
+
+  def _build_train_step(self):
+    model = self.model
+    optimizer = self._optimizer
+    base_rng = self._base_rng
+
+    def step_fn(state: TrainState, features, labels
+                ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+      rng = jax.random.fold_in(base_rng, state.step)
+
+      def loss_fn(params):
+        variables = {"params": params, **state.model_state}
+        loss, (metrics, new_model_state) = model.model_train_fn(
+            variables, features, labels, rngs={"dropout": rng})
+        return loss, (metrics, new_model_state)
+
+      grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+      (_, (metrics, new_model_state)), grads = grad_fn(state.params)
+      updates, new_opt_state = optimizer.update(
+          grads, state.opt_state, state.params)
+      new_params = optax.apply_updates(state.params, updates)
+      new_ema = state.ema_params
+      if new_ema is not None:
+        new_ema = optax.incremental_update(
+            new_params, new_ema,
+            step_size=1.0 - model.avg_model_params_decay)
+      new_state = state.replace(
+          step=state.step + 1,
+          params=new_params,
+          model_state=new_model_state,
+          opt_state=new_opt_state,
+          ema_params=new_ema)
+      return new_state, metrics
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(self._replicated, self._batch_sharding,
+                      self._batch_sharding),
+        out_shardings=(self._replicated, self._replicated),
+        donate_argnums=(0,))
+
+  def _build_eval_step(self):
+    model = self.model
+
+    def step_fn(state: TrainState, features, labels
+                ) -> Dict[str, jnp.ndarray]:
+      variables = state.variables(use_ema=True)
+      return model.model_eval_fn(variables, features, labels)
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(self._replicated, self._batch_sharding,
+                      self._batch_sharding),
+        out_shardings=self._replicated)
+
+  # --- public API ----------------------------------------------------------
+
+  def train_step(self, state: TrainState, features, labels=None
+                 ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+    """One compiled optimizer step. Donates `state`."""
+    if self._train_step is None:
+      self._train_step = self._build_train_step()
+    return self._train_step(state, features, labels)
+
+  def eval_step(self, state: TrainState, features, labels=None
+                ) -> Dict[str, jnp.ndarray]:
+    """One compiled eval step (EMA params when enabled)."""
+    if self._eval_step is None:
+      self._eval_step = self._build_eval_step()
+    return self._eval_step(state, features, labels)
+
+  def shard_batch(self, batch: Any) -> Any:
+    """Host batch → mesh, split over the data axis (the infeed)."""
+    return mesh_lib.shard_batch(self.mesh, batch, self.data_axis)
+
+  def predict_fn(self, state: TrainState):
+    """Jitted PREDICT-mode closure over current (EMA) params, for export
+    and predictors (SURVEY.md §3.3)."""
+    variables = jax.device_get(state.variables(use_ema=True))
+    model = self.model
+
+    def predict(features):
+      return model.predict_fn(variables, features)
+
+    return jax.jit(predict)

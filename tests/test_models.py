@@ -20,6 +20,9 @@ from tensor2robot_tpu.preprocessors import (
     center_crop,
     random_crop,
 )
+from tensor2robot_tpu.preprocessors.image_preprocessors import (
+    adjust_saturation,
+)
 from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
 from tensor2robot_tpu.specs import tensorspec_utils as ts
 from tensor2robot_tpu.utils.mocks import MockT2RModel
@@ -304,6 +307,53 @@ class TestPreprocessors:
     with pytest.raises(ValueError, match="float"):
       apply_photometric_distortions(
           np.zeros((1, 4, 4, 3), np.uint8), rng)
+
+  def test_distortion_math_matches_tf(self):
+    """adjust_saturation must be the HSV scale tf.image does, and contrast
+    must scale around the per-channel mean like tf.image.adjust_contrast.
+
+    TF ops run in a subprocess: executing a TF kernel in this process
+    starves XLA's in-process CPU collective rendezvous on low-core hosts
+    (oneDNN threadpool), aborting later 8-virtual-device tests.
+    """
+    import subprocess, sys, tempfile
+    rng = np.random.default_rng(0)
+    images = rng.random((3, 8, 8, 3)).astype(np.float32)
+    factors = (0.3, 0.5, 1.0, 1.7)
+    with tempfile.TemporaryDirectory() as d:
+      np.save(f"{d}/images.npy", images)
+      code = f"""
+import numpy as np, os
+os.environ["CUDA_VISIBLE_DEVICES"] = ""
+import tensorflow as tf
+images = np.load("{d}/images.npy")
+sat = {{}}
+for f in {factors!r}:
+    sat[str(f)] = np.stack(
+        [tf.image.adjust_saturation(im, f).numpy() for im in images])
+contrast = tf.image.adjust_contrast(images, 0.6).numpy()
+np.savez("{d}/tf_out.npz", contrast=contrast,
+         **{{f"sat_{{k}}": v for k, v in sat.items()}})
+"""
+      subprocess.run([sys.executable, "-c", code], check=True,
+                     capture_output=True)
+      tf_out = np.load(f"{d}/tf_out.npz")
+    for factor in factors:
+      ours = adjust_saturation(images, np.float32(factor))
+      np.testing.assert_allclose(
+          ours, tf_out[f"sat_{factor}"], atol=1e-5)
+    means = images.mean(axis=(1, 2), keepdims=True)
+    ours_contrast = (images - means) * 0.6 + means
+    np.testing.assert_allclose(ours_contrast, tf_out["contrast"], atol=1e-5)
+
+  def test_wired_mode_mismatch_raises(self):
+    from tensor2robot_tpu.data.default_input_generator import (
+        DefaultRandomInputGenerator,
+    )
+    gen = DefaultRandomInputGenerator(batch_size=2)
+    gen.set_specification_from_model(MockT2RModel(), modes.TRAIN)
+    with pytest.raises(ValueError, match="wired for mode"):
+      gen.create_dataset_fn(modes.EVAL)
 
   def test_image_preprocessor_train_vs_eval(self):
     out_spec = {
