@@ -1,0 +1,34 @@
+"""Export: versioned serving artifacts from training state.
+
+Reference parity: export_generators/ (SURVEY.md §2, §3.2). Two formats:
+  - Native (flagship): jax.export StableHLO + orbax/npz params + spec
+    assets — pure-JAX serving, compiles for cpu and tpu.
+  - SavedModel (compatibility): jax2tf → tf.saved_model, preserving the
+    reference's robot-side serving contract (SURVEY.md §3.3 boundary).
+"""
+
+from tensor2robot_tpu.export.export_utils import (
+    SPEC_ASSET_NAME,
+    latest_export_dir,
+    list_export_versions,
+    read_spec_assets,
+    versioned_export_dir,
+    write_spec_assets,
+)
+from tensor2robot_tpu.export.abstract_export_generator import (
+    AbstractExportGenerator,
+)
+from tensor2robot_tpu.export.native_export_generator import (
+    NativeExportGenerator,
+)
+
+__all__ = [
+    "AbstractExportGenerator",
+    "NativeExportGenerator",
+    "SPEC_ASSET_NAME",
+    "latest_export_dir",
+    "list_export_versions",
+    "read_spec_assets",
+    "versioned_export_dir",
+    "write_spec_assets",
+]

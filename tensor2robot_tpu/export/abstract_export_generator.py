@@ -1,0 +1,60 @@
+"""AbstractExportGenerator — spec-driven serving-artifact emission.
+
+Reference parity: export_generators/abstract_export_generator.py
+(SURVEY.md §2): build a serving signature from the model's feature specs
+(labels stripped), emit a versioned artifact, embed spec assets. The
+receiver-fn machinery is gone — a JAX serving fn is just predict_fn closed
+over variables; what remains is the signature/versioning/asset contract.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class AbstractExportGenerator(abc.ABC):
+  """Builds versioned serving artifacts for a model."""
+
+  def __init__(self, export_root: Optional[str] = None):
+    self._export_root = export_root
+    self._model = None
+    self._feature_spec: Optional[ts.TensorSpecStruct] = None
+
+  @property
+  def export_root(self) -> str:
+    if self._export_root is None:
+      raise ValueError("export_root not set.")
+    return self._export_root
+
+  @export_root.setter
+  def export_root(self, value: str) -> None:
+    self._export_root = value
+
+  def set_specification_from_model(self, model) -> None:
+    """Captures the serving signature: the model-ready (preprocessor-out)
+    PREDICT feature specs, labels stripped."""
+    self._model = model
+    self._feature_spec = ts.flatten_spec_structure(
+        model.preprocessor.get_out_feature_specification(modes.PREDICT))
+
+  @property
+  def feature_spec(self) -> ts.TensorSpecStruct:
+    if self._feature_spec is None:
+      raise ValueError(
+          "Export generator has no specs; call "
+          "set_specification_from_model first.")
+    return self._feature_spec
+
+  @abc.abstractmethod
+  def export(self, variables: Any) -> str:
+    """Writes one new version under export_root; returns its final dir.
+
+    Args:
+      variables: the flax variables dict ({"params": ..., batch_stats...})
+        to serve — callers pass EMA params when use_avg_model_params
+        (TrainState.variables(use_ema=True)).
+    """

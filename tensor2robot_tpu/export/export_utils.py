@@ -1,0 +1,114 @@
+"""Versioned export directories + spec assets.
+
+Reference parity: the trainer→robot boundary of SURVEY.md §3.3 — a
+directory of timestamped versions on shared storage, written atomically
+(robots poll concurrently), each embedding spec assets so predictors can
+recover the input signature without the model's Python code
+(export_generators/abstract_export_generator.py spec-asset embedding).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional, Tuple
+
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+SPEC_ASSET_NAME = "t2r_assets.json"
+
+
+def normalize_serving_outputs(outputs) -> dict:
+  """The serving output contract: a flat {str: array} dict.
+
+  Shared by every exporter and predictor so artifacts and in-process
+  serving can never diverge on key naming.
+  """
+  if hasattr(outputs, "items"):
+    return {str(k): v for k, v in outputs.items()}
+  return {"inference_output": outputs}
+
+
+def versioned_export_dir(export_root: str) -> Tuple[str, str]:
+  """Returns (tmp_dir, final_dir) for a new monotonic version.
+
+  Write into tmp_dir, then os.rename to final_dir — the atomic-publish
+  protocol robots rely on (they never see partial exports).
+  """
+  os.makedirs(export_root, exist_ok=True)
+  version = int(time.time())
+  existing = list_export_versions(export_root)
+  if existing and version <= existing[-1]:
+    version = existing[-1] + 1
+  final_dir = os.path.join(export_root, str(version))
+  tmp_dir = os.path.join(export_root, f".tmp-{version}")
+  return tmp_dir, final_dir
+
+
+def publish(tmp_dir: str, final_dir: str) -> str:
+  os.rename(tmp_dir, final_dir)
+  return final_dir
+
+
+def list_export_versions(export_root: str) -> List[int]:
+  """Sorted numeric version subdirs of export_root."""
+  if not os.path.isdir(export_root):
+    return []
+  versions = []
+  for name in os.listdir(export_root):
+    if name.isdigit() and os.path.isdir(os.path.join(export_root, name)):
+      versions.append(int(name))
+  return sorted(versions)
+
+
+def latest_export_dir(export_root: str) -> Optional[str]:
+  versions = list_export_versions(export_root)
+  if not versions:
+    return None
+  return os.path.join(export_root, str(versions[-1]))
+
+
+def garbage_collect_exports(export_root: str, keep: int) -> List[str]:
+  """Removes all but the newest `keep` versions (reference: version GC in
+  the async export hook, SURVEY.md §3.4). Returns removed dirs."""
+  import shutil
+  removed = []
+  versions = list_export_versions(export_root)
+  for version in versions[:-keep] if keep > 0 else versions:
+    path = os.path.join(export_root, str(version))
+    shutil.rmtree(path, ignore_errors=True)
+    removed.append(path)
+  return removed
+
+
+def write_spec_assets(
+    export_dir: str,
+    feature_spec: ts.SpecStructure,
+    label_spec: Optional[ts.SpecStructure] = None,
+    extra: Optional[dict] = None,
+) -> str:
+  """Writes the spec asset file predictors read the signature from."""
+  payload = {
+      "feature_spec": json.loads(ts.to_serialized(feature_spec)),
+      "label_spec": (json.loads(ts.to_serialized(label_spec))
+                     if label_spec is not None else None),
+      "extra": extra or {},
+  }
+  path = os.path.join(export_dir, SPEC_ASSET_NAME)
+  with open(path, "w") as f:
+    json.dump(payload, f, indent=2, sort_keys=True)
+  return path
+
+
+def read_spec_assets(
+    export_dir: str,
+) -> Tuple[ts.TensorSpecStruct, Optional[ts.TensorSpecStruct], dict]:
+  """Reads back (feature_spec, label_spec, extra)."""
+  path = os.path.join(export_dir, SPEC_ASSET_NAME)
+  with open(path) as f:
+    payload = json.load(f)
+  feature_spec = ts.from_serialized(json.dumps(payload["feature_spec"]))
+  label_spec = (ts.from_serialized(json.dumps(payload["label_spec"]))
+                if payload.get("label_spec") is not None else None)
+  return feature_spec, label_spec, payload.get("extra", {})

@@ -1,0 +1,95 @@
+"""Native export: jax.export (StableHLO) + orbax variables + spec assets.
+
+The TPU-native serving format (replaces the reference's SavedModel for
+pure-JAX consumers): the PREDICT computation is serialized as portable
+StableHLO compiled-for {cpu, tpu}, so a robot-side process deserializes
+and calls it with zero model Python code — the same decoupling as
+SURVEY.md §3.3's SavedModel contract.
+
+Artifact layout (one versioned dir):
+    serving_fn.bin     jax.export.Exported.serialize() of
+                       serve(variables, *features_in_key_order) -> {name: out}
+    variables/         orbax StandardCheckpointer save of the variables dict
+    t2r_assets.json    feature specs + feature key order + metadata
+
+Batch dim is exported symbolically ("b") so serving batch size is free —
+QT-Opt's CEM sweeps batch sizes at inference (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from tensor2robot_tpu.export import export_utils
+from tensor2robot_tpu.export.abstract_export_generator import (
+    AbstractExportGenerator,
+)
+
+SERVING_FN_NAME = "serving_fn.bin"
+VARIABLES_DIR = "variables"
+
+
+class NativeExportGenerator(AbstractExportGenerator):
+  """Emits the native StableHLO serving artifact."""
+
+  def __init__(
+      self,
+      export_root: Optional[str] = None,
+      platforms: Sequence[str] = ("cpu", "tpu"),
+      polymorphic_batch: bool = True,
+  ):
+    super().__init__(export_root)
+    self._platforms = tuple(platforms)
+    self._polymorphic_batch = polymorphic_batch
+
+  def export(self, variables: Any) -> str:
+    model = self._model
+    feature_spec = self.feature_spec
+    keys = list(feature_spec.keys())
+
+    def serve(variables, *feature_arrays):
+      features = type(feature_spec)(zip(keys, feature_arrays))
+      # Plain dict out: stable across deserialization without custom
+      # pytree registration on the consumer side.
+      return export_utils.normalize_serving_outputs(
+          model.predict_fn(variables, features))
+
+    if self._polymorphic_batch:
+      batch = jax.export.symbolic_shape("b")[0]
+    else:
+      batch = 1
+    arg_shapes = [
+        jax.ShapeDtypeStruct((batch,) + spec.shape, spec.dtype)
+        for spec in feature_spec.values()
+    ]
+    variables = jax.device_get(variables)
+    var_shapes = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        variables)
+    exported = jax.export.export(
+        jax.jit(serve), platforms=self._platforms)(var_shapes, *arg_shapes)
+
+    tmp_dir, final_dir = export_utils.versioned_export_dir(self.export_root)
+    os.makedirs(tmp_dir, exist_ok=True)
+    with open(os.path.join(tmp_dir, SERVING_FN_NAME), "wb") as f:
+      f.write(exported.serialize())
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(
+        os.path.abspath(os.path.join(tmp_dir, VARIABLES_DIR)), variables)
+    # StandardCheckpointer writes asynchronously; the atomic publish rename
+    # below must not race the background serialization.
+    checkpointer.wait_until_finished()
+    checkpointer.close()
+    export_utils.write_spec_assets(
+        tmp_dir, feature_spec,
+        extra={
+            "format": "jax_export_stablehlo",
+            "feature_keys": keys,
+            "platforms": list(self._platforms),
+        })
+    return export_utils.publish(tmp_dir, final_dir)

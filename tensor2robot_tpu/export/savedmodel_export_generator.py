@@ -1,0 +1,127 @@
+"""SavedModel export via jax2tf — the reference's robot serving contract.
+
+Reference parity: export_generators/default_export_generator.py
+§DefaultExportGenerator (SURVEY.md §2, §3.2): versioned SavedModels with
+spec assets, a numpy-feed signature, and a serialized-tf.Example
+signature (parse_example built from the same specs). Robots running the
+reference's ExportedSavedModelPredictor keep working unchanged — the
+BASELINE north star.
+
+TF is imported lazily: the core framework never needs it; only this
+compatibility exporter does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.export import export_utils
+from tensor2robot_tpu.export.abstract_export_generator import (
+    AbstractExportGenerator,
+)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class SavedModelExportGenerator(AbstractExportGenerator):
+  """Emits tf.saved_model versions from JAX variables via jax2tf."""
+
+  def __init__(
+      self,
+      export_root: Optional[str] = None,
+      platforms: Sequence[str] = ("cpu", "tpu"),
+      with_tf_example_signature: bool = True,
+  ):
+    super().__init__(export_root)
+    self._platforms = tuple(platforms)
+    self._with_tf_example_signature = with_tf_example_signature
+
+  def export(self, variables: Any) -> str:
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+
+    model = self._model
+    feature_spec = self.feature_spec
+    keys = list(feature_spec.keys())
+    variables = jax.device_get(variables)
+
+    def serve(variables, *feature_arrays):
+      features = type(feature_spec)(zip(keys, feature_arrays))
+      return export_utils.normalize_serving_outputs(
+          model.predict_fn(variables, features))
+
+    tf_fn = jax2tf.convert(
+        serve,
+        polymorphic_shapes=[None] + ["(b, ...)"] * len(keys),
+        native_serialization_platforms=self._platforms,
+        with_gradient=False)
+
+    module = tf.Module()
+    # Weights as tf.Variables so the SavedModel is self-contained.
+    module._variables = tf.nest.map_structure(
+        lambda x: tf.Variable(np.asarray(x), trainable=False), variables)
+    flat_module_vars = tf.nest.flatten(module._variables)
+    var_struct = tf.nest.map_structure(lambda x: 0, variables)
+
+    def _rebuild():
+      return tf.nest.pack_sequence_as(var_struct, flat_module_vars)
+
+    tensor_specs = [
+        tf.TensorSpec((None,) + spec.shape, tf.as_dtype(np.dtype(spec.dtype)),
+                      name=key)
+        for key, spec in feature_spec.items()
+    ]
+
+    @tf.function(input_signature=tensor_specs)
+    def serving_default(*feature_arrays):
+      return tf_fn(_rebuild(), *feature_arrays)
+
+    signatures = {"serving_default": serving_default}
+
+    if self._with_tf_example_signature:
+      parse_schema = self._tf_example_schema(tf, feature_spec)
+
+      @tf.function(
+          input_signature=[tf.TensorSpec([None], tf.string, name="input")])
+      def serving_tf_example(serialized):
+        parsed = tf.io.parse_example(serialized, parse_schema)
+        arrays = []
+        for key, spec in feature_spec.items():
+          value = parsed[key]
+          if ts.is_encoded_image_spec(spec):
+            value = tf.map_fn(
+                lambda s: tf.io.decode_image(
+                    s, channels=spec.shape[-1], expand_animations=False),
+                value, fn_output_signature=tf.uint8)
+            value = tf.reshape(value, (-1,) + spec.shape)
+          arrays.append(value)
+        return tf_fn(_rebuild(), *arrays)
+
+      signatures["tf_example"] = serving_tf_example
+
+    tmp_dir, final_dir = export_utils.versioned_export_dir(self.export_root)
+    tf.saved_model.save(module, tmp_dir, signatures=signatures)
+    export_utils.write_spec_assets(
+        tmp_dir, feature_spec,
+        extra={"format": "tf_saved_model", "feature_keys": keys,
+               "platforms": list(self._platforms)})
+    return export_utils.publish(tmp_dir, final_dir)
+
+  @staticmethod
+  def _tf_example_schema(tf, feature_spec: ts.TensorSpecStruct):
+    """Specs → tf.io parse schema (reference §tensorspec_to_feature_dict)."""
+    schema = {}
+    for key, spec in feature_spec.items():
+      if ts.is_encoded_image_spec(spec):
+        schema[key] = tf.io.FixedLenFeature([], tf.string)
+      elif spec.varlen_default_value is not None:
+        schema[key] = tf.io.FixedLenSequenceFeature(
+            spec.shape[1:], tf.as_dtype(np.dtype(spec.dtype)),
+            allow_missing=True,
+            default_value=spec.varlen_default_value)
+      else:
+        schema[key] = tf.io.FixedLenFeature(
+            spec.shape, tf.as_dtype(np.dtype(spec.dtype)))
+    return schema

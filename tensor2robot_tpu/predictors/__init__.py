@@ -1,0 +1,20 @@
+"""Predictors — the on-robot inference API.
+
+Reference parity: predictors/ (SURVEY.md §2, §3.3): restore-with-timeout
+(robots start before the first export exists), predict(np dict)→np dict
+validated against spec assets, hot-reload on new versions.
+"""
+
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.predictors.checkpoint_predictor import (
+    CheckpointPredictor,
+)
+from tensor2robot_tpu.predictors.exported_model_predictor import (
+    ExportedModelPredictor,
+)
+
+__all__ = [
+    "AbstractPredictor",
+    "CheckpointPredictor",
+    "ExportedModelPredictor",
+]

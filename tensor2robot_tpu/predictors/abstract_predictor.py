@@ -1,0 +1,74 @@
+"""AbstractPredictor: the robot-facing inference contract.
+
+Reference parity: predictors/abstract_predictor.py §AbstractPredictor
+(SURVEY.md §2): predict/restore/init_randomly/model_version/
+get_feature_specification/close, with restore-with-timeout semantics.
+"""
+
+from __future__ import annotations
+
+import abc
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class AbstractPredictor(abc.ABC):
+  """Loads a trained artifact and serves predict() on the robot."""
+
+  @abc.abstractmethod
+  def restore(self, timeout_s: float = 0.0) -> bool:
+    """Loads (or hot-reloads) the newest available model.
+
+    Blocks up to timeout_s waiting for a first model to appear (robots
+    start before the trainer's first export — SURVEY.md §2 predictors
+    row). Returns True when a model is loaded.
+    """
+
+  @abc.abstractmethod
+  def predict(
+      self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Runs inference on a batched numpy feature dict."""
+
+  @abc.abstractmethod
+  def get_feature_specification(self) -> ts.TensorSpecStruct:
+    """The (flat) feature spec predict() expects."""
+
+  @property
+  @abc.abstractmethod
+  def model_version(self) -> int:
+    """Monotonic version of the loaded model; -1 before restore."""
+
+  def init_randomly(self) -> None:
+    """Initializes with random weights (debug/bring-up; reference
+    §init_randomly). Optional: default raises."""
+    raise NotImplementedError(
+        f"{type(self).__name__} does not support init_randomly.")
+
+  def close(self) -> None:
+    """Releases resources."""
+
+  def assert_is_loaded(self) -> None:
+    if self.model_version < 0:
+      raise ValueError("Predictor has no model loaded; call restore().")
+
+  def _validate_features(
+      self, features: Dict[str, np.ndarray]) -> ts.TensorSpecStruct:
+    """Validates a batched feature dict against the spec (batch dim free)."""
+    spec = self.get_feature_specification()
+    flat = ts.TensorSpecStruct(
+        (k, np.asarray(v)) for k, v in dict(features).items())
+    return ts.validate_and_flatten(spec, flat, batched=True)
+
+  @staticmethod
+  def _wait_for(predicate, timeout_s: float, poll_s: float = 0.5):
+    """Polls predicate() until truthy or timeout; returns its value."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+      value = predicate()
+      if value or time.monotonic() >= deadline:
+        return value
+      time.sleep(min(poll_s, max(0.0, deadline - time.monotonic())))

@@ -1,0 +1,105 @@
+"""CheckpointPredictor: rebuild the model in-process, restore a checkpoint.
+
+Reference parity: predictors/checkpoint_predictor.py §CheckpointPredictor
+(SURVEY.md §2): no export needed — the predictor owns the model's Python
+code, restores the latest checkpoint from a training run dir, and serves
+predict(). Uses EMA params when the run trained with use_avg_model_params
+(the reference's eval/export swap).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class CheckpointPredictor(AbstractPredictor):
+  """Serves a T2R model directly from its checkpoint directory."""
+
+  def __init__(self, model, checkpoint_dir: Optional[str] = None):
+    """Args:
+      model: an AbstractT2RModel instance (provides module + specs).
+      checkpoint_dir: the training run's checkpoint dir; None allows only
+        init_randomly.
+    """
+    self._model = model
+    self._checkpoint_dir = checkpoint_dir
+    self._variables = None
+    self._version = -1
+    self._predict = None
+
+  def _build_predict(self):
+    from tensor2robot_tpu.export import export_utils
+    model = self._model
+
+    def predict(variables, features):
+      return export_utils.normalize_serving_outputs(
+          model.predict_fn(variables, features))
+
+    return jax.jit(predict)
+
+  def restore(self, timeout_s: float = 0.0) -> bool:
+    if self._checkpoint_dir is None:
+      raise ValueError("No checkpoint_dir given; use init_randomly().")
+    import os
+    directory = os.path.abspath(self._checkpoint_dir)
+
+    def _latest():
+      try:
+        with ocp.CheckpointManager(directory) as manager:
+          step = manager.latest_step()
+          if step is None or step <= self._version:
+            return None
+          return step, manager.restore(
+              step, args=ocp.args.StandardRestore())
+      except FileNotFoundError:
+        return None
+
+    result = self._wait_for(_latest, timeout_s)
+    if not result:
+      return self._version >= 0
+    step, restored = result
+    ema = restored.get("ema_params")
+    params = ema if ema is not None else restored["params"]
+    model_state = restored.get("model_state")
+    self._variables = {
+        "params": params,
+        **(model_state if model_state is not None else {}),
+    }
+    self._version = int(step)
+    if self._predict is None:
+      self._predict = self._build_predict()
+    return True
+
+  def init_randomly(self) -> None:
+    variables = self._model.init_variables(jax.random.key(0))
+    self._variables = jax.device_get(variables)
+    self._version = 0
+    if self._predict is None:
+      self._predict = self._build_predict()
+
+  def predict(
+      self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    self.assert_is_loaded()
+    flat = self._validate_features(features)
+    outputs = self._predict(self._variables, flat)
+    return {k: np.asarray(v) for k, v in outputs.items()}
+
+  def get_feature_specification(self) -> ts.TensorSpecStruct:
+    return ts.flatten_spec_structure(
+        self._model.preprocessor.get_out_feature_specification(
+            modes.PREDICT))
+
+  @property
+  def model_version(self) -> int:
+    return self._version
+
+  def close(self) -> None:
+    self._variables = None

@@ -1,0 +1,85 @@
+"""ExportedModelPredictor: serve a native (jax.export) artifact.
+
+Reference parity: predictors/exported_savedmodel_predictor.py
+§ExportedSavedModelPredictor (SURVEY.md §3.3): poll an export root for the
+newest version, block-with-timeout until the first export exists, predict
+on numpy dicts, hot-reload on newer versions. The artifact carries the
+whole computation (StableHLO) + weights + specs, so no model Python code
+is needed on the robot.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from tensor2robot_tpu.export import export_utils
+from tensor2robot_tpu.export.native_export_generator import (
+    SERVING_FN_NAME,
+    VARIABLES_DIR,
+)
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class ExportedModelPredictor(AbstractPredictor):
+  """Polls export_root and serves the newest native artifact."""
+
+  def __init__(self, export_root: str):
+    self._export_root = export_root
+    self._version = -1
+    self._call = None
+    self._variables = None
+    self._feature_spec: Optional[ts.TensorSpecStruct] = None
+    self._feature_keys = None
+
+  # --- loading -------------------------------------------------------------
+
+  def _newest_version(self) -> int:
+    versions = export_utils.list_export_versions(self._export_root)
+    return versions[-1] if versions else -1
+
+  def restore(self, timeout_s: float = 0.0) -> bool:
+    newest = self._wait_for(
+        lambda: (v := self._newest_version()) > self._version and v,
+        timeout_s)
+    if not newest:
+      return self._version >= 0
+    export_dir = os.path.join(self._export_root, str(newest))
+    with open(os.path.join(export_dir, SERVING_FN_NAME), "rb") as f:
+      exported = jax.export.deserialize(bytearray(f.read()))
+    variables = ocp.StandardCheckpointer().restore(
+        os.path.abspath(os.path.join(export_dir, VARIABLES_DIR)))
+    feature_spec, _, extra = export_utils.read_spec_assets(export_dir)
+    self._call = jax.jit(exported.call)
+    self._variables = jax.tree_util.tree_map(jax.numpy.asarray, variables)
+    self._feature_spec = feature_spec
+    self._feature_keys = extra["feature_keys"]
+    self._version = newest
+    return True
+
+  # --- serving -------------------------------------------------------------
+
+  def predict(
+      self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    self.assert_is_loaded()
+    flat = self._validate_features(features)
+    args = [np.asarray(flat[key]) for key in self._feature_keys]
+    outputs = self._call(self._variables, *args)
+    return {k: np.asarray(v) for k, v in outputs.items()}
+
+  def get_feature_specification(self) -> ts.TensorSpecStruct:
+    self.assert_is_loaded()
+    return self._feature_spec
+
+  @property
+  def model_version(self) -> int:
+    return self._version
+
+  def close(self) -> None:
+    self._call = None
+    self._variables = None

@@ -1,0 +1,70 @@
+"""ExportedSavedModelPredictor — load jax2tf SavedModels like the reference.
+
+Reference parity: predictors/exported_savedmodel_predictor.py (SURVEY.md
+§3.3): poll export root, load newest SavedModel with the TF C++ loader,
+predict via the serving_default signature, hot-reload on new versions.
+Kept for robot stacks that still link TF; pure-JAX consumers should use
+ExportedModelPredictor.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.export import export_utils
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class ExportedSavedModelPredictor(AbstractPredictor):
+  """Polls export_root and serves the newest SavedModel."""
+
+  def __init__(self, export_root: str):
+    self._export_root = export_root
+    self._version = -1
+    self._fn = None
+    self._loaded = None
+    self._feature_spec: Optional[ts.TensorSpecStruct] = None
+
+  def _newest_version(self) -> int:
+    versions = export_utils.list_export_versions(self._export_root)
+    return versions[-1] if versions else -1
+
+  def restore(self, timeout_s: float = 0.0) -> bool:
+    import tensorflow as tf
+    newest = self._wait_for(
+        lambda: (v := self._newest_version()) > self._version and v,
+        timeout_s)
+    if not newest:
+      return self._version >= 0
+    export_dir = os.path.join(self._export_root, str(newest))
+    loaded = tf.saved_model.load(export_dir)
+    self._loaded = loaded  # keep a reference: signatures hold weak refs
+    self._fn = loaded.signatures["serving_default"]
+    self._feature_spec, _, _ = export_utils.read_spec_assets(export_dir)
+    self._version = newest
+    return True
+
+  def predict(
+      self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    import tensorflow as tf
+    self.assert_is_loaded()
+    flat = self._validate_features(features)
+    outputs = self._fn(**{k: tf.constant(np.asarray(v))
+                          for k, v in flat.items()})
+    return {k: v.numpy() for k, v in outputs.items()}
+
+  def get_feature_specification(self) -> ts.TensorSpecStruct:
+    self.assert_is_loaded()
+    return self._feature_spec
+
+  @property
+  def model_version(self) -> int:
+    return self._version
+
+  def close(self) -> None:
+    self._fn = None
+    self._loaded = None

@@ -162,11 +162,13 @@ class Trainer:
 
   def predict_fn(self, state: TrainState):
     """Jitted PREDICT-mode closure over current (EMA) params, for export
-    and predictors (SURVEY.md §3.3)."""
-    variables = jax.device_get(state.variables(use_ema=True))
+    and predictors (SURVEY.md §3.3). Variables are a jit argument, not
+    baked-in constants — keeps the executable weight-free."""
+    variables = state.variables(use_ema=True)
     model = self.model
+    jitted = jax.jit(model.predict_fn)
 
     def predict(features):
-      return model.predict_fn(variables, features)
+      return jitted(variables, features)
 
-    return jax.jit(predict)
+    return predict

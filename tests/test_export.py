@@ -1,0 +1,237 @@
+"""Tests for export generators + predictors.
+
+The SavedModel (TF) path runs in a subprocess: executing TF kernels
+in-process starves XLA's CPU collective rendezvous on low-core hosts
+(see test_models.py::test_distortion_math_matches_tf).
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.data.default_input_generator import (
+    DefaultRandomInputGenerator,
+)
+from tensor2robot_tpu.export import export_utils
+from tensor2robot_tpu.export.native_export_generator import (
+    NativeExportGenerator,
+)
+from tensor2robot_tpu.predictors.checkpoint_predictor import (
+    CheckpointPredictor,
+)
+from tensor2robot_tpu.predictors.exported_model_predictor import (
+    ExportedModelPredictor,
+)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+from tensor2robot_tpu.train.checkpoints import CheckpointManager
+from tensor2robot_tpu.train.trainer import Trainer
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+
+def _trained_state(model, steps=2):
+  trainer = Trainer(model, seed=0)
+  state = trainer.create_train_state()
+  gen = DefaultRandomInputGenerator(batch_size=8, seed=0)
+  gen.set_specification_from_model(model, modes.TRAIN)
+  it = gen.create_dataset_fn(modes.TRAIN)()
+  for _ in range(steps):
+    features, labels = trainer.shard_batch(next(it))
+    state, _ = trainer.train_step(state, features, labels)
+  return trainer, state
+
+
+class TestExportUtils:
+
+  def test_versioned_dirs_monotonic(self, tmp_path):
+    root = str(tmp_path / "exports")
+    tmp1, final1 = export_utils.versioned_export_dir(root)
+    os.makedirs(tmp1)
+    export_utils.publish(tmp1, final1)
+    tmp2, final2 = export_utils.versioned_export_dir(root)
+    assert int(os.path.basename(final2)) > int(os.path.basename(final1))
+
+  def test_gc(self, tmp_path):
+    root = str(tmp_path / "exports")
+    for v in (100, 200, 300):
+      os.makedirs(os.path.join(root, str(v)))
+    export_utils.garbage_collect_exports(root, keep=2)
+    assert export_utils.list_export_versions(root) == [200, 300]
+
+  def test_spec_assets_round_trip(self, tmp_path):
+    spec = ts.TensorSpecStruct(
+        {"x": ts.ExtendedTensorSpec((3,), np.float32, name="x")})
+    export_utils.write_spec_assets(str(tmp_path), spec, extra={"k": "v"})
+    feature_spec, label_spec, extra = export_utils.read_spec_assets(
+        str(tmp_path))
+    assert feature_spec["x"].shape == (3,)
+    assert label_spec is None
+    assert extra["k"] == "v"
+
+
+class TestNativeExportRoundTrip:
+
+  def test_export_predict_matches_model(self, tmp_path):
+    model = MockT2RModel()
+    trainer, state = _trained_state(model)
+    root = str(tmp_path / "exports")
+    gen = NativeExportGenerator(export_root=root)
+    gen.set_specification_from_model(model)
+    export_dir = gen.export(jax.device_get(state.variables(use_ema=True)))
+    assert os.path.basename(os.path.dirname(export_dir)) == "exports"
+
+    predictor = ExportedModelPredictor(root)
+    assert predictor.model_version == -1
+    assert predictor.restore()
+    assert predictor.model_version == int(os.path.basename(export_dir))
+
+    x = np.random.default_rng(0).random((4, 3)).astype(np.float32)
+    out = predictor.predict({"x": x})
+    expected = model.predict_fn(
+        jax.device_get(state.variables(use_ema=True)),
+        ts.TensorSpecStruct({"x": x}))
+    np.testing.assert_allclose(
+        out["inference_output"], np.asarray(expected["inference_output"]),
+        atol=1e-5)
+
+  def test_polymorphic_batch(self, tmp_path):
+    model = MockT2RModel()
+    _, state = _trained_state(model)
+    root = str(tmp_path / "exports")
+    gen = NativeExportGenerator(export_root=root)
+    gen.set_specification_from_model(model)
+    gen.export(jax.device_get(state.variables()))
+    predictor = ExportedModelPredictor(root)
+    predictor.restore()
+    for batch in (1, 5, 64):
+      out = predictor.predict(
+          {"x": np.zeros((batch, 3), np.float32)})
+      assert out["inference_output"].shape == (batch, 1)
+
+  def test_hot_reload_and_timeout(self, tmp_path):
+    model = MockT2RModel()
+    _, state = _trained_state(model)
+    root = str(tmp_path / "exports")
+    predictor = ExportedModelPredictor(root)
+    # Nothing exported yet: restore times out politely.
+    assert not predictor.restore(timeout_s=0.2)
+    gen = NativeExportGenerator(export_root=root)
+    gen.set_specification_from_model(model)
+    first = gen.export(jax.device_get(state.variables()))
+    assert predictor.restore()
+    v1 = predictor.model_version
+    second = gen.export(jax.device_get(state.variables()))
+    assert predictor.restore()
+    assert predictor.model_version > v1
+    # No newer version: restore keeps serving the current one.
+    assert predictor.restore()
+
+  def test_predict_validates_spec(self, tmp_path):
+    model = MockT2RModel()
+    _, state = _trained_state(model)
+    root = str(tmp_path / "exports")
+    gen = NativeExportGenerator(export_root=root)
+    gen.set_specification_from_model(model)
+    gen.export(jax.device_get(state.variables()))
+    predictor = ExportedModelPredictor(root)
+    predictor.restore()
+    with pytest.raises(ValueError):
+      predictor.predict({"x": np.zeros((2, 7), np.float32)})
+    with pytest.raises(ValueError):
+      predictor.predict({"wrong_key": np.zeros((2, 3), np.float32)})
+
+
+class TestCheckpointPredictor:
+
+  def test_restore_and_predict(self, tmp_path):
+    model = MockT2RModel(use_avg_model_params=True)
+    trainer, state = _trained_state(model, steps=3)
+    ckpt_dir = str(tmp_path / "ckpt")
+    manager = CheckpointManager(ckpt_dir)
+    manager.save(int(state.step), state)
+    manager.close()
+
+    predictor = CheckpointPredictor(model, ckpt_dir)
+    assert predictor.restore()
+    assert predictor.model_version == 3
+    x = np.random.default_rng(1).random((2, 3)).astype(np.float32)
+    out = predictor.predict({"x": x})
+    # EMA params are what gets served.
+    expected = model.predict_fn(
+        jax.device_get(state.variables(use_ema=True)),
+        ts.TensorSpecStruct({"x": x}))
+    np.testing.assert_allclose(
+        out["inference_output"], np.asarray(expected["inference_output"]),
+        atol=1e-5)
+
+  def test_init_randomly(self):
+    model = MockT2RModel()
+    predictor = CheckpointPredictor(model)
+    predictor.init_randomly()
+    out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+    assert out["inference_output"].shape == (2, 1)
+
+  def test_unloaded_raises(self):
+    predictor = CheckpointPredictor(MockT2RModel())
+    with pytest.raises(ValueError, match="no model loaded"):
+      predictor.predict({"x": np.zeros((1, 3), np.float32)})
+
+
+class TestSavedModelPath:
+
+  def test_savedmodel_round_trip_subprocess(self, tmp_path):
+    """Full jax2tf export + TF load + predict parity, in a subprocess."""
+    script = f"""
+import os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax, numpy as np
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.export.savedmodel_export_generator import (
+    SavedModelExportGenerator)
+from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+    ExportedSavedModelPredictor)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+model = MockT2RModel()
+variables = jax.device_get(model.init_variables(jax.random.key(0)))
+root = {str(tmp_path / "sm")!r}
+gen = SavedModelExportGenerator(export_root=root,
+                                platforms=("cpu",))
+gen.set_specification_from_model(model)
+export_dir = gen.export(variables)
+
+pred = ExportedSavedModelPredictor(root)
+assert pred.restore(), "restore failed"
+x = np.random.default_rng(0).random((3, 3)).astype(np.float32)
+out = pred.predict({{"x": x}})
+expected = model.predict_fn(variables, ts.TensorSpecStruct({{"x": x}}))
+np.testing.assert_allclose(
+    out["inference_output"], np.asarray(expected["inference_output"]),
+    atol=1e-5)
+
+# tf.Example signature.
+import tensorflow as tf
+loaded = tf.saved_model.load(export_dir)
+ex = tf.train.Example(features=tf.train.Features(feature={{
+    "x": tf.train.Feature(float_list=tf.train.FloatList(
+        value=x[0].tolist()))}}))
+out2 = loaded.signatures["tf_example"](
+    tf.constant([ex.SerializeToString()]))
+np.testing.assert_allclose(
+    out2["inference_output"].numpy()[0], out["inference_output"][0],
+    atol=1e-5)
+print("SAVEDMODEL-OK")
+"""
+    result = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=420)
+    assert "SAVEDMODEL-OK" in result.stdout, (
+        f"stdout={result.stdout}\nstderr={result.stderr[-3000:]}")
