@@ -1,0 +1,80 @@
+"""Benchmark: flagship train-step throughput on the attached TPU chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "steps/sec/chip", "vs_baseline": N}
+
+Baseline note (BASELINE.md): the reference publishes no numbers; the
+driver's north star is >=3x the fork's 8xA100 NCCL steps/sec, chip-
+normalized, on the QT-Opt grasping Q-fn — a number that must be
+self-measured and is unmeasurable here (no A100s, no network). Until a
+driver-measured GPU figure exists, vs_baseline is computed against the
+documented estimate below.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Estimated per-chip steps/sec of the fork's TF1 + tf.distribute(NCCL)
+# 8xA100 baseline on the QT-Opt Q-function (472x472 conv tower, batch
+# 32/GPU): conv-heavy TF1 graphs on A100 typically sustain ~10-20
+# steps/sec/GPU at this size; we take the optimistic end as the bar.
+BASELINE_STEPS_PER_SEC_PER_CHIP = 20.0
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+
+
+def main() -> None:
+  from __graft_entry__ import _example_batch, _flagship_model
+  from tensor2robot_tpu import modes
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  from tensor2robot_tpu.specs import tensorspec_utils as ts
+  from tensor2robot_tpu.train.trainer import Trainer
+
+  model, _ = _flagship_model()
+  try:
+    batch_size = model.benchmark_batch_size  # flagship models override
+  except AttributeError:
+    batch_size = 32
+  n_chips = jax.device_count()
+  mesh = mesh_lib.create_mesh()
+  trainer = Trainer(model, mesh=mesh, seed=0)
+  state = trainer.create_train_state(batch_size=batch_size)
+
+  features = _example_batch(model, batch_size, modes.TRAIN)
+  label_spec = model.get_label_specification(modes.TRAIN)
+  labels = jax.tree_util.tree_map(
+      lambda s: jnp.zeros((batch_size,) + s.shape, s.dtype),
+      ts.flatten_spec_structure(label_spec),
+      is_leaf=lambda x: isinstance(x, ts.ExtendedTensorSpec))
+  if not list(labels.keys()):
+    labels = None
+  features, labels = trainer.shard_batch((features, labels))
+
+  for _ in range(WARMUP_STEPS):
+    state, metrics = trainer.train_step(state, features, labels)
+  jax.block_until_ready(metrics["loss"])
+
+  start = time.perf_counter()
+  for _ in range(MEASURE_STEPS):
+    state, metrics = trainer.train_step(state, features, labels)
+  jax.block_until_ready(metrics["loss"])
+  elapsed = time.perf_counter() - start
+
+  steps_per_sec_per_chip = MEASURE_STEPS / elapsed / n_chips
+  print(json.dumps({
+      "metric": f"{type(model).__name__} train steps/sec/chip "
+                f"(batch {batch_size})",
+      "value": round(steps_per_sec_per_chip, 3),
+      "unit": "steps/sec/chip",
+      "vs_baseline": round(
+          steps_per_sec_per_chip / BASELINE_STEPS_PER_SEC_PER_CHIP, 3),
+  }))
+
+
+if __name__ == "__main__":
+  main()

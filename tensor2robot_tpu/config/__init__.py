@@ -1,0 +1,31 @@
+"""t2r_config: dependency injection for run definitions.
+
+Reference parity: SURVEY.md §5.6 — the reference is gin-config end-to-end
+(two-level UX: .gin config files + --gin_bindings overrides, with the
+operative config dumped to model_dir for reproducibility). gin is not in
+this image, so this is a small native implementation of the same UX:
+`@configurable` callables, `name.param = value` bindings with `@ref`,
+`@ref()` and `%macro` values, file+override parsing, operative-config dump.
+"""
+
+from tensor2robot_tpu.config.config import (
+    bind,
+    clear_config,
+    configurable,
+    get_configurable,
+    operative_config_str,
+    parse_config,
+    parse_config_files_and_bindings,
+    query_binding,
+)
+
+__all__ = [
+    "bind",
+    "clear_config",
+    "configurable",
+    "get_configurable",
+    "operative_config_str",
+    "parse_config",
+    "parse_config_files_and_bindings",
+    "query_binding",
+]

@@ -1,0 +1,36 @@
+"""Registers the framework's standard components as configurables.
+
+Imported by the CLI (and anyone using config files) so `@Name` references
+resolve without per-module imports — the analogue of the reference's
+modules importing gin at definition time.
+"""
+
+from tensor2robot_tpu.config import configurable
+
+from tensor2robot_tpu.data.default_input_generator import (
+    DefaultRandomInputGenerator,
+    DefaultRecordInputGenerator,
+    FractionalRecordInputGenerator,
+    WeightedRecordInputGenerator,
+)
+from tensor2robot_tpu.export.native_export_generator import (
+    NativeExportGenerator,
+)
+from tensor2robot_tpu.export.savedmodel_export_generator import (
+    SavedModelExportGenerator,
+)
+from tensor2robot_tpu.hooks.async_export_hook import AsyncExportHookBuilder
+from tensor2robot_tpu.utils import optimizers  # noqa: F401 (registers)
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+for _cls in (
+    DefaultRandomInputGenerator,
+    DefaultRecordInputGenerator,
+    FractionalRecordInputGenerator,
+    WeightedRecordInputGenerator,
+    NativeExportGenerator,
+    SavedModelExportGenerator,
+    AsyncExportHookBuilder,
+    MockT2RModel,
+):
+  configurable(_cls)

@@ -71,15 +71,38 @@ def latest_export_dir(export_root: str) -> Optional[str]:
 
 def garbage_collect_exports(export_root: str, keep: int) -> List[str]:
   """Removes all but the newest `keep` versions (reference: version GC in
-  the async export hook, SURVEY.md §3.4). Returns removed dirs."""
+  the async export hook, SURVEY.md §3.4). keep <= 0 disables GC (never
+  deletes the just-published version). Returns removed dirs."""
   import shutil
+  if keep <= 0:
+    return []
   removed = []
   versions = list_export_versions(export_root)
-  for version in versions[:-keep] if keep > 0 else versions:
+  for version in versions[:-keep]:
     path = os.path.join(export_root, str(version))
     shutil.rmtree(path, ignore_errors=True)
     removed.append(path)
   return removed
+
+
+def resolve_export_root(generator, model_dir: Optional[str]) -> None:
+  """Defaults a generator's export_root under model_dir (shared by the
+  end-of-train export and the async export hook so they cannot drift)."""
+  try:
+    generator.export_root
+  except ValueError:
+    if not model_dir:
+      raise ValueError(
+          "Export generator has no export_root and no model_dir to "
+          "default it under.")
+    generator.export_root = os.path.join(model_dir, "export", "latest")
+
+
+def export_and_gc(generator, variables, keep: int) -> str:
+  """One export + version GC (the publish step both export paths share)."""
+  export_dir = generator.export(variables)
+  garbage_collect_exports(generator.export_root, keep=keep)
+  return export_dir
 
 
 def write_spec_assets(

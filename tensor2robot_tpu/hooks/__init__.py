@@ -1,0 +1,19 @@
+"""Hooks: pluggable train-loop observers (exports, logging, custom).
+
+Reference parity: hooks/ (SURVEY.md §2, §3.4) — HookBuilder interface for
+gin-injected SessionRunHooks; async SavedModel export triggered by
+checkpoint saves, with fleet-dir copy + version GC.
+"""
+
+from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder
+from tensor2robot_tpu.hooks.async_export_hook import (
+    AsyncExportHook,
+    AsyncExportHookBuilder,
+)
+
+__all__ = [
+    "Hook",
+    "HookBuilder",
+    "AsyncExportHook",
+    "AsyncExportHookBuilder",
+]

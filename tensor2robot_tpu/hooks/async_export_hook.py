@@ -1,0 +1,100 @@
+"""Async export hook: serve-fresh-models-while-training.
+
+Reference parity: hooks/async_export_hook_builder.py (SURVEY.md §3.4) —
+TPU training can't export inline, so a checkpoint-triggered listener
+exports in a worker thread and GCs old versions, keeping the robot
+fleet's poll directory fresh during long runs. Same design here: the
+device never stalls on export — the hook snapshots (device_get) the EMA
+variables at a checkpoint boundary and hands them to a single worker
+thread; if an export is still running the new request replaces any
+queued one (exporting every checkpoint is pointless if exports are
+slower than checkpoints).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import List, Optional
+
+import jax
+
+from tensor2robot_tpu.export import export_utils
+from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder
+
+_log = logging.getLogger(__name__)
+
+
+class AsyncExportHook(Hook):
+  """Exports on checkpoint saves via a worker thread."""
+
+  def __init__(self, export_generator, keep: int = 5):
+    self._generator = export_generator
+    self._keep = keep
+    # maxsize=1 + replace-on-full: at most one pending export.
+    self._pending: "queue.Queue" = queue.Queue(maxsize=1)
+    self._worker: Optional[threading.Thread] = None
+    self._stop = object()
+    self._last_submitted_step: Optional[int] = None
+
+  def begin(self, trainer, state, model_dir: str) -> None:
+    export_utils.resolve_export_root(self._generator, model_dir)
+    self._generator.set_specification_from_model(trainer.model)
+    self._worker = threading.Thread(
+        target=self._run, name="t2r-async-export", daemon=True)
+    self._worker.start()
+
+  def _submit(self, item) -> None:
+    """Put, replacing any not-yet-started export (mid-train use only)."""
+    while True:
+      try:
+        self._pending.put_nowait(item)
+        return
+      except queue.Full:
+        try:
+          self._pending.get_nowait()
+        except queue.Empty:
+          pass
+
+  def after_checkpoint(self, step: int, state) -> None:
+    # Snapshot on the host: the donated device buffers are reused by the
+    # next step, so the worker must not touch them.
+    variables = jax.device_get(state.variables(use_ema=True))
+    self._submit(variables)
+    self._last_submitted_step = int(state.step)
+
+  def _run(self) -> None:
+    while True:
+      item = self._pending.get()
+      if item is self._stop:
+        return
+      try:
+        export_dir = export_utils.export_and_gc(
+            self._generator, item, keep=self._keep)
+        _log.info("Async export published %s", export_dir)
+      except Exception:
+        _log.exception("Async export failed; training continues.")
+
+  def end(self, state) -> None:
+    # Drain, exporting the final state unless the final checkpoint already
+    # submitted this exact step. Blocking puts (not _submit): the stop
+    # signal must never displace a queued final export.
+    if self._last_submitted_step != int(state.step):
+      variables = jax.device_get(state.variables(use_ema=True))
+      self._pending.put(variables)
+    self._pending.put(self._stop)
+    if self._worker is not None:
+      self._worker.join(timeout=600)
+
+
+class AsyncExportHookBuilder(HookBuilder):
+  """Builds AsyncExportHook (config-injectable; reference
+  §AsyncExportHookBuilder)."""
+
+  def __init__(self, export_generator, keep: int = 5):
+    self._export_generator = export_generator
+    self._keep = keep
+
+  def create_hooks(self, trainer, model_dir: str) -> List[Hook]:
+    return [AsyncExportHook(self._export_generator, keep=self._keep)]

@@ -1,0 +1,36 @@
+"""Hook + HookBuilder protocol.
+
+Reference parity: hooks/hook_builder.py §HookBuilder (SURVEY.md §2). The
+Estimator SessionRunHook lifecycle maps onto the host loop's sync points:
+begin → (after_step at each metric sync) → after_checkpoint (the
+CheckpointSaverListener.after_save analogue) → end.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List
+
+
+class Hook:
+  """Train-loop observer; all methods optional overrides, host-side."""
+
+  def begin(self, trainer, state, model_dir: str) -> None:
+    """Called once before the first step."""
+
+  def after_step(self, state, metrics: dict) -> None:
+    """Called at metric sync points (not every step) with host scalars."""
+
+  def after_checkpoint(self, step: int, state) -> None:
+    """Called after a checkpoint save is scheduled for `step`."""
+
+  def end(self, state) -> None:
+    """Called once after the last step (and final checkpoint)."""
+
+
+class HookBuilder(abc.ABC):
+  """Factory of hooks, injectable via config (reference §HookBuilder)."""
+
+  @abc.abstractmethod
+  def create_hooks(self, trainer, model_dir: str) -> List[Hook]:
+    """Builds hooks for this run."""

@@ -100,6 +100,17 @@ def shard_batch(mesh: Mesh, batch: Any, axis: str = "data") -> Any:
   array) — the host→device boundary of SURVEY.md §3.1 without infeed
   queues.
   """
+  axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+  leaves = jax.tree_util.tree_leaves(batch)
+  if leaves:
+    global_size = np.shape(leaves[0])[0] * jax.process_count()
+    if global_size % axis_size != 0:
+      raise ValueError(
+          f"Global batch size {global_size} (local "
+          f"{np.shape(leaves[0])[0]} × {jax.process_count()} processes) is "
+          f"not divisible by the {axis!r} mesh axis ({axis_size} devices); "
+          "choose a batch size that is a multiple of the data-parallel "
+          "degree.")
   sharding = batch_sharding(mesh, axis)
   if jax.process_count() == 1:
     return jax.device_put(batch, sharding)

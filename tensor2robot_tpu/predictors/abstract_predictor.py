@@ -63,6 +63,21 @@ class AbstractPredictor(abc.ABC):
         (k, np.asarray(v)) for k, v in dict(features).items())
     return ts.validate_and_flatten(spec, flat, batched=True)
 
+  def _poll_newer_version(self, export_root: str,
+                          timeout_s: float) -> Optional[int]:
+    """Waits for an export version newer than model_version; None if the
+    timeout expires first (shared by the export-dir predictors)."""
+    from tensor2robot_tpu.export import export_utils
+
+    def newest():
+      versions = export_utils.list_export_versions(export_root)
+      candidate = versions[-1] if versions else None
+      if candidate is not None and candidate > self.model_version:
+        return candidate
+      return None
+
+    return self._wait_for(newest, timeout_s)
+
   @staticmethod
   def _wait_for(predicate, timeout_s: float, poll_s: float = 0.5):
     """Polls predicate() until truthy or timeout; returns its value."""

@@ -34,6 +34,7 @@ class CheckpointPredictor(AbstractPredictor):
     self._variables = None
     self._version = -1
     self._predict = None
+    self._manager = None
 
   def _build_predict(self):
     from tensor2robot_tpu.export import export_utils
@@ -52,15 +53,19 @@ class CheckpointPredictor(AbstractPredictor):
     directory = os.path.abspath(self._checkpoint_dir)
 
     def _latest():
-      try:
-        with ocp.CheckpointManager(directory) as manager:
-          step = manager.latest_step()
-          if step is None or step <= self._version:
-            return None
-          return step, manager.restore(
-              step, args=ocp.args.StandardRestore())
-      except FileNotFoundError:
+      if self._manager is None:
+        if not os.path.isdir(directory):
+          # Trainer hasn't created the run dir yet; keep polling without
+          # creating it (create=True would defeat typo detection).
+          return None
+        self._manager = ocp.CheckpointManager(
+            directory, options=ocp.CheckpointManagerOptions(create=False))
+      self._manager.reload()  # pick up steps written since construction
+      step = self._manager.latest_step()
+      if step is None or step <= self._version:
         return None
+      return step, self._manager.restore(
+          step, args=ocp.args.StandardRestore())
 
     result = self._wait_for(_latest, timeout_s)
     if not result:
@@ -103,3 +108,6 @@ class CheckpointPredictor(AbstractPredictor):
 
   def close(self) -> None:
     self._variables = None
+    if self._manager is not None:
+      self._manager.close()
+      self._manager = None

@@ -39,15 +39,9 @@ class ExportedModelPredictor(AbstractPredictor):
 
   # --- loading -------------------------------------------------------------
 
-  def _newest_version(self) -> int:
-    versions = export_utils.list_export_versions(self._export_root)
-    return versions[-1] if versions else -1
-
   def restore(self, timeout_s: float = 0.0) -> bool:
-    newest = self._wait_for(
-        lambda: (v := self._newest_version()) > self._version and v,
-        timeout_s)
-    if not newest:
+    newest = self._poll_newer_version(self._export_root, timeout_s)
+    if newest is None:
       return self._version >= 0
     export_dir = os.path.join(self._export_root, str(newest))
     with open(os.path.join(export_dir, SERVING_FN_NAME), "rb") as f:
@@ -68,6 +62,12 @@ class ExportedModelPredictor(AbstractPredictor):
       self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     self.assert_is_loaded()
     flat = self._validate_features(features)
+    missing = [key for key in self._feature_keys if key not in flat]
+    if missing:
+      raise ValueError(
+          f"Features {missing} are required by this export (all exported "
+          "keys are positional inputs of the serialized computation, "
+          "including specs marked optional at training time).")
     args = [np.asarray(flat[key]) for key in self._feature_keys]
     outputs = self._call(self._variables, *args)
     return {k: np.asarray(v) for k, v in outputs.items()}

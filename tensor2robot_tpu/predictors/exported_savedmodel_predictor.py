@@ -29,16 +29,10 @@ class ExportedSavedModelPredictor(AbstractPredictor):
     self._loaded = None
     self._feature_spec: Optional[ts.TensorSpecStruct] = None
 
-  def _newest_version(self) -> int:
-    versions = export_utils.list_export_versions(self._export_root)
-    return versions[-1] if versions else -1
-
   def restore(self, timeout_s: float = 0.0) -> bool:
     import tensorflow as tf
-    newest = self._wait_for(
-        lambda: (v := self._newest_version()) > self._version and v,
-        timeout_s)
-    if not newest:
+    newest = self._poll_newer_version(self._export_root, timeout_s)
+    if newest is None:
       return self._version >= 0
     export_dir = os.path.join(self._export_root, str(newest))
     loaded = tf.saved_model.load(export_dir)
@@ -53,6 +47,12 @@ class ExportedSavedModelPredictor(AbstractPredictor):
     import tensorflow as tf
     self.assert_is_loaded()
     flat = self._validate_features(features)
+    missing = [k for k in self._feature_spec.keys() if k not in flat]
+    if missing:
+      raise ValueError(
+          f"Features {missing} are required by this SavedModel signature "
+          "(specs marked optional at training time are still baked into "
+          "the export's input signature).")
     outputs = self._fn(**{k: tf.constant(np.asarray(v))
                           for k, v in flat.items()})
     return {k: v.numpy() for k, v in outputs.items()}

@@ -156,6 +156,11 @@ class Trainer:
       self._eval_step = self._build_eval_step()
     return self._eval_step(state, features, labels)
 
+  @property
+  def batch_sharding(self):
+    """Public sharding for batched inputs (prefetch/infeed consumers)."""
+    return self._batch_sharding
+
   def shard_batch(self, batch: Any) -> Any:
     """Host batch → mesh, split over the data axis (the infeed)."""
     return mesh_lib.shard_batch(self.mesh, batch, self.data_axis)
@@ -164,7 +169,9 @@ class Trainer:
     """Jitted PREDICT-mode closure over current (EMA) params, for export
     and predictors (SURVEY.md §3.3). Variables are a jit argument, not
     baked-in constants — keeps the executable weight-free."""
-    variables = state.variables(use_ema=True)
+    # Host snapshot: the state's device buffers are donated to the next
+    # train_step and would be invalidated under the closure's feet.
+    variables = jax.device_get(state.variables(use_ema=True))
     model = self.model
     jitted = jax.jit(model.predict_fn)
 

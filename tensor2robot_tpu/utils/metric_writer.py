@@ -1,0 +1,70 @@
+"""Metric writing: TensorBoard event files + JSONL, no TensorFlow ops.
+
+Reference parity: SURVEY.md §5.5 — tf.summary scalars routed via
+host_call on TPU, TensorBoard as the only dashboard. Here metrics are
+plain host floats at sync points (no host_call machinery needed); events
+are written with the tensorboard proto + our own TFRecord framing, so the
+trainer never executes a TF kernel (which would fight XLA's CPU
+collectives for threads on small hosts). metrics.jsonl mirrors every
+scalar for grep/pandas without TensorBoard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from typing import Dict, Mapping, Optional
+
+from tensor2robot_tpu.data.tfrecord import TFRecordWriter
+
+try:
+  from tensorboard.compat.proto import event_pb2
+  _HAVE_TB = True
+except Exception:  # pragma: no cover - tensorboard ships with TF here.
+  _HAVE_TB = False
+
+
+class MetricWriter:
+  """Writes scalar metrics to TB event files and metrics.jsonl."""
+
+  def __init__(self, logdir: str):
+    os.makedirs(logdir, exist_ok=True)
+    self._logdir = logdir
+    self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
+    self._events: Optional[TFRecordWriter] = None
+    if _HAVE_TB:
+      fname = (f"events.out.tfevents.{int(time.time())}."
+               f"{socket.gethostname()}")
+      self._events = TFRecordWriter(os.path.join(logdir, fname))
+      first = event_pb2.Event(
+          wall_time=time.time(), file_version="brain.Event:2")
+      self._events.write(first.SerializeToString())
+
+  def write_scalars(self, step: int, scalars: Mapping[str, float]) -> None:
+    now = time.time()
+    record: Dict[str, float] = {"step": int(step), "wall_time": now}
+    record.update({k: float(v) for k, v in scalars.items()})
+    self._jsonl.write(json.dumps(record) + "\n")
+    if self._events is not None:
+      event = event_pb2.Event(wall_time=now, step=int(step))
+      for key, value in scalars.items():
+        v = event.summary.value.add()
+        v.tag = key
+        v.simple_value = float(value)
+      self._events.write(event.SerializeToString())
+    # Sync points are already rate-limited (log_every_steps); flushing
+    # here means a crashed run keeps everything written so far.
+    self.flush()
+
+  def flush(self) -> None:
+    self._jsonl.flush()
+    if self._events is not None:
+      self._events.flush()
+
+  def close(self) -> None:
+    self.flush()
+    self._jsonl.close()
+    if self._events is not None:
+      self._events.close()

@@ -1,0 +1,71 @@
+"""Config-injectable optimizer factories.
+
+Reference parity: the gin-chosen optimizer of §create_optimizer
+(SURVEY.md §3.1) — the reference wired tf.train optimizers through gin;
+here optax transformations through t2r_config. Each factory returns a
+zero-arg callable suitable for AbstractT2RModel(optimizer_fn=...), with
+optional piecewise-constant LR schedules standing in for
+utils/global_step_functions.py's step-dependent schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import optax
+
+from tensor2robot_tpu.config import configurable
+
+
+def _schedule(learning_rate: float,
+              boundaries_and_scales: Optional[Sequence[Tuple[int, float]]]):
+  if not boundaries_and_scales:
+    return learning_rate
+  return optax.piecewise_constant_schedule(
+      learning_rate, dict(boundaries_and_scales))
+
+
+@configurable
+def create_adam_optimizer(
+    learning_rate: float = 1e-4,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    boundaries_and_scales=None,
+):
+  """Adam (the reference's default optimizer family)."""
+  return lambda: optax.adam(
+      _schedule(learning_rate, boundaries_and_scales), b1=b1, b2=b2, eps=eps)
+
+
+@configurable
+def create_momentum_optimizer(
+    learning_rate: float = 1e-2,
+    momentum: float = 0.9,
+    nesterov: bool = False,
+    boundaries_and_scales=None,
+):
+  return lambda: optax.sgd(
+      _schedule(learning_rate, boundaries_and_scales),
+      momentum=momentum, nesterov=nesterov)
+
+
+@configurable
+def create_sgd_optimizer(
+    learning_rate: float = 1e-2,
+    boundaries_and_scales=None,
+):
+  return lambda: optax.sgd(_schedule(learning_rate, boundaries_and_scales))
+
+
+@configurable
+def create_rmsprop_optimizer(
+    learning_rate: float = 1e-3,
+    decay: float = 0.9,
+    momentum: float = 0.0,
+    eps: float = 1e-10,
+    boundaries_and_scales=None,
+):
+  return lambda: optax.rmsprop(
+      _schedule(learning_rate, boundaries_and_scales),
+      decay=decay, momentum=momentum, eps=eps)

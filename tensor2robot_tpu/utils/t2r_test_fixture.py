@@ -1,0 +1,55 @@
+"""T2RModelFixture: run the REAL train loop in-process for tests.
+
+Reference parity: utils/t2r_test_fixture.py (SURVEY.md §4) — the
+reference's core testing idea: MockT2RModel-style models + random
+spec-conformant input generators let `train_eval_model` run a few real
+steps (train → eval → checkpoint → export → predictor restore) with no
+data files and no accelerator. Every research model gets a cheap
+"does it train 2 steps" test this way.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tensor2robot_tpu.data.default_input_generator import (
+    DefaultRandomInputGenerator,
+)
+from tensor2robot_tpu.train.train_eval import TrainEvalResult, train_eval_model
+
+
+class T2RModelFixture:
+  """Drives real train_eval_model on synthetic data."""
+
+  def __init__(self, seed: int = 0):
+    self._seed = seed
+
+  def random_train(
+      self,
+      model,
+      max_train_steps: int = 3,
+      batch_size: int = 8,
+      eval_steps: int = 2,
+      model_dir: Optional[str] = None,
+      export_generator=None,
+      **kwargs,
+  ) -> TrainEvalResult:
+    """Trains `model` a few steps on random spec-conformant batches."""
+    result = train_eval_model(
+        model,
+        input_generator_train=DefaultRandomInputGenerator(
+            batch_size=batch_size, seed=self._seed),
+        input_generator_eval=DefaultRandomInputGenerator(
+            batch_size=batch_size, seed=self._seed + 1),
+        max_train_steps=max_train_steps,
+        eval_steps=eval_steps,
+        model_dir=model_dir,
+        export_generator=export_generator,
+        seed=self._seed,
+        log_every_steps=1,
+        **kwargs,
+    )
+    assert int(result.state.step) == max_train_steps
+    assert all(map(lambda v: v == v, result.train_metrics.values())), (
+        f"NaN in train metrics: {result.train_metrics}")
+    return result
