@@ -1,0 +1,20 @@
+"""Reusable network layers (reference layers/ zoo, SURVEY.md §2)."""
+
+from tensor2robot_tpu.layers.vision_layers import (
+    ImagesToFeatures,
+    ImageFeaturesToPose,
+    spatial_softmax,
+)
+from tensor2robot_tpu.layers.resnet import ResNet, FilmResNet
+from tensor2robot_tpu.layers import mdn
+from tensor2robot_tpu.layers import snail
+
+__all__ = [
+    "ImagesToFeatures",
+    "ImageFeaturesToPose",
+    "spatial_softmax",
+    "ResNet",
+    "FilmResNet",
+    "mdn",
+    "snail",
+]

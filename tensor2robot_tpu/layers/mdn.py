@@ -1,0 +1,96 @@
+"""Mixture density network heads (multimodal action distributions).
+
+Reference parity: layers/mdn.py §predict_mixture_params,
+§get_mixture_distribution, §gaussian_mixture_approximate_mode
+(SURVEY.md §2): diagonal-Gaussian mixtures over action vectors, used by
+VRGripper's behavior-cloning heads. Implemented directly on jnp (no
+distribution-library dependency): log-prob via logsumexp, which XLA fuses
+into the surrounding loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MixtureParams(NamedTuple):
+  """Diagonal GMM parameters: shapes (..., K), (..., K, D), (..., K, D)."""
+  log_alphas: jnp.ndarray
+  mus: jnp.ndarray
+  log_sigmas: jnp.ndarray
+
+
+def predict_mixture_params(
+    inputs: jnp.ndarray,
+    num_components: int,
+    sample_size: int,
+    module: Any = None,
+    name: str = "mdn",
+) -> MixtureParams:
+  """Projects features to GMM parameters (reference
+  §predict_mixture_params).
+
+  Args:
+    inputs: (..., F) features.
+    num_components: K mixture components.
+    sample_size: D, dimensionality of the predicted variable.
+    module: optional enclosing flax module scope (unused; Dense below is
+      created in the caller's scope via nn.Dense when called inside
+      @nn.compact).
+  """
+  del module
+  k, d = num_components, sample_size
+  raw = nn.Dense(k * (2 * d + 1), dtype=jnp.float32, name=name)(
+      inputs.astype(jnp.float32))
+  alphas = raw[..., :k]
+  rest = raw[..., k:].reshape(raw.shape[:-1] + (k, 2 * d))
+  mus = rest[..., :d]
+  # Softplus-shifted sigma, clipped away from zero for stability.
+  log_sigmas = jnp.log(nn.softplus(rest[..., d:]) + 1e-5)
+  return MixtureParams(
+      log_alphas=nn.log_softmax(alphas, axis=-1),
+      mus=mus,
+      log_sigmas=log_sigmas)
+
+
+def log_prob(params: MixtureParams, x: jnp.ndarray) -> jnp.ndarray:
+  """GMM log-likelihood of x: (..., D) → (...)."""
+  x = x[..., None, :]  # broadcast over components
+  inv_var = jnp.exp(-2.0 * params.log_sigmas)
+  component_ll = -0.5 * jnp.sum(
+      ((x - params.mus) ** 2) * inv_var
+      + 2.0 * params.log_sigmas
+      + jnp.log(2.0 * jnp.pi),
+      axis=-1)
+  return jax.scipy.special.logsumexp(
+      params.log_alphas + component_ll, axis=-1)
+
+
+def negative_log_likelihood(params: MixtureParams,
+                            x: jnp.ndarray) -> jnp.ndarray:
+  """Mean NLL — the reference's MDN training loss."""
+  return -jnp.mean(log_prob(params, x))
+
+
+def gaussian_mixture_approximate_mode(params: MixtureParams) -> jnp.ndarray:
+  """Mean of the highest-weight component (reference
+  §gaussian_mixture_approximate_mode) — the deterministic action at
+  serving time."""
+  best = jnp.argmax(params.log_alphas, axis=-1)
+  return jnp.take_along_axis(
+      params.mus, best[..., None, None], axis=-2).squeeze(-2)
+
+
+def sample(params: MixtureParams, rng: jax.Array) -> jnp.ndarray:
+  """Draws one sample per batch element."""
+  rng_comp, rng_normal = jax.random.split(rng)
+  component = jax.random.categorical(rng_comp, params.log_alphas, axis=-1)
+  mu = jnp.take_along_axis(
+      params.mus, component[..., None, None], axis=-2).squeeze(-2)
+  sigma = jnp.exp(jnp.take_along_axis(
+      params.log_sigmas, component[..., None, None], axis=-2)).squeeze(-2)
+  return mu + sigma * jax.random.normal(rng_normal, mu.shape)

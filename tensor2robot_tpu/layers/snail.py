@@ -1,0 +1,103 @@
+"""SNAIL building blocks: temporal convs + causal attention.
+
+Reference parity: layers/snail.py §CausalConv, §TCBlock, §AttentionBlock
+(SURVEY.md §2) — Mishra et al.'s Simple Neural AttentIve meta-Learner
+blocks used for meta-learning over episode sequences. Sequences here are
+short robot episodes (SURVEY.md §5.7), so attention is materialized
+directly; long-context variants belong to the parallel/ ring-attention
+path, not here.
+
+TPU notes: causal conv is a static pad + valid conv (no dynamic shapes);
+everything operates on (B, T, D) with T static under jit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class CausalConv(nn.Module):
+  """1D dilated causal convolution over (B, T, D)."""
+
+  features: int
+  kernel_size: int = 2
+  dilation: int = 1
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    pad = self.dilation * (self.kernel_size - 1)
+    x = jnp.pad(x.astype(self.dtype), ((0, 0), (pad, 0), (0, 0)))
+    return nn.Conv(
+        self.features, (self.kernel_size,),
+        kernel_dilation=(self.dilation,),
+        padding="VALID", dtype=self.dtype)(x)
+
+
+class DenseBlock(nn.Module):
+  """Gated causal conv whose output is concatenated to its input
+  (WaveNet-style gating: tanh ⊙ sigmoid)."""
+
+  filters: int
+  dilation: int
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    xf = CausalConv(self.filters, dilation=self.dilation,
+                    dtype=self.dtype, name="filter")(x)
+    xg = CausalConv(self.filters, dilation=self.dilation,
+                    dtype=self.dtype, name="gate")(x)
+    activations = jnp.tanh(xf) * nn.sigmoid(xg)
+    return jnp.concatenate([x.astype(self.dtype), activations], axis=-1)
+
+
+class TCBlock(nn.Module):
+  """Stack of DenseBlocks with dilations 1, 2, 4, … covering seq_len."""
+
+  seq_len: int
+  filters: int
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    if x.shape[1] > self.seq_len:
+      raise ValueError(
+          f"TCBlock(seq_len={self.seq_len}) got length-{x.shape[1]} "
+          "input; the dilation schedule would not cover it.")
+    for i in range(int(math.ceil(math.log2(max(self.seq_len, 2))))):
+      x = DenseBlock(self.filters, dilation=2 ** i,
+                     dtype=self.dtype, name=f"dense{i}")(x)
+    return x
+
+
+class AttentionBlock(nn.Module):
+  """Single-head causal attention; output concatenated to input."""
+
+  key_size: int
+  value_size: int
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    t = x.shape[1]
+    keys = nn.Dense(self.key_size, dtype=self.dtype, name="key")(
+        x.astype(self.dtype))
+    queries = nn.Dense(self.key_size, dtype=self.dtype, name="query")(
+        x.astype(self.dtype))
+    values = nn.Dense(self.value_size, dtype=self.dtype, name="value")(
+        x.astype(self.dtype))
+    # float32 logits/softmax: attention normalization is precision-
+    # sensitive even at short T.
+    logits = jnp.einsum("btk,bsk->bts", queries, keys).astype(jnp.float32)
+    logits = logits / np.sqrt(self.key_size)
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    logits = jnp.where(mask[None], logits, -1e30)
+    weights = nn.softmax(logits, axis=-1).astype(self.dtype)
+    read = jnp.einsum("bts,bsv->btv", weights, values)
+    return jnp.concatenate([x.astype(self.dtype), read], axis=-1)

@@ -1,0 +1,89 @@
+"""Vision layers: conv towers + spatial softmax for robot cameras.
+
+Reference parity: layers/vision_layers.py §BuildImagesToFeaturesModel,
+§BuildImageFeaturesToPoseModel, §spatial_softmax (SURVEY.md §2 layers
+row). TPU notes: NHWC layout (XLA:TPU native), bfloat16 activations, all
+convs stride/kernel static so XLA tiles them onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def spatial_softmax(features: jnp.ndarray,
+                    temperature: float = 1.0) -> jnp.ndarray:
+  """Expected (x, y) image-coordinates per channel ("feature points").
+
+  Args:
+    features: (B, H, W, C) activations.
+    temperature: softmax temperature.
+
+  Returns:
+    (B, 2*C): per-channel expected coordinates in [-1, 1] (x then y),
+    the keypoint pooling the reference used between conv tower and pose
+    head.
+  """
+  b, h, w, c = features.shape
+  dtype = features.dtype
+  # Stable softmax over space, per (batch, channel).
+  logits = features.astype(jnp.float32).transpose(0, 3, 1, 2)
+  logits = logits.reshape(b, c, h * w) / temperature
+  attention = nn.softmax(logits, axis=-1).reshape(b, c, h, w)
+  xs = jnp.linspace(-1.0, 1.0, w)
+  ys = jnp.linspace(-1.0, 1.0, h)
+  expected_x = jnp.sum(attention * xs[None, None, None, :], axis=(2, 3))
+  expected_y = jnp.sum(attention * ys[None, None, :, None], axis=(2, 3))
+  return jnp.concatenate([expected_x, expected_y], axis=-1).astype(dtype)
+
+
+class ImagesToFeatures(nn.Module):
+  """Conv tower: camera image → spatial feature map.
+
+  Reference §BuildImagesToFeaturesModel: a VGG-ish stack of 3x3 convs
+  with occasional stride-2 downsamples, batch norm, relu.
+  """
+
+  filters: Sequence[int] = (32, 64, 64, 128)
+  strides: Sequence[int] = (2, 2, 2, 1)
+  use_batch_norm: bool = True
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, images: jnp.ndarray, train: bool = False):
+    if len(self.filters) != len(self.strides):
+      raise ValueError(
+          f"filters ({len(self.filters)}) and strides "
+          f"({len(self.strides)}) must have equal length.")
+    x = images.astype(self.dtype)
+    for i, (width, stride) in enumerate(zip(self.filters, self.strides)):
+      x = nn.Conv(width, (3, 3), strides=(stride, stride),
+                  dtype=self.dtype, name=f"conv{i}")(x)
+      if self.use_batch_norm:
+        x = nn.BatchNorm(use_running_average=not train,
+                         dtype=self.dtype, name=f"bn{i}")(x)
+      x = nn.relu(x)
+    return x
+
+
+class ImageFeaturesToPose(nn.Module):
+  """Spatial-softmax keypoints → MLP → pose vector.
+
+  Reference §BuildImageFeaturesToPoseModel.
+  """
+
+  pose_dim: int = 2
+  hidden_sizes: Sequence[int] = (64, 64)
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, feature_map: jnp.ndarray, train: bool = False):
+    x = spatial_softmax(feature_map)
+    for i, width in enumerate(self.hidden_sizes):
+      x = nn.Dense(width, dtype=self.dtype, name=f"fc{i}")(x)
+      x = nn.relu(x)
+    # Head in float32: small, and keeps regression targets full-precision.
+    return nn.Dense(self.pose_dim, dtype=jnp.float32, name="pose")(x)

@@ -1,0 +1,219 @@
+"""Tests for the layers zoo: shapes, dtypes, and semantic properties."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.layers import mdn, snail
+from tensor2robot_tpu.layers.resnet import FilmResNet, ResNet
+from tensor2robot_tpu.layers.vision_layers import (
+    ImageFeaturesToPose,
+    ImagesToFeatures,
+    spatial_softmax,
+)
+
+
+class TestVisionLayers:
+
+  def test_spatial_softmax_finds_peak(self):
+    """A sharp activation peak → expected coords at the peak location."""
+    features = np.full((1, 9, 11, 2), -10.0, np.float32)
+    features[0, 2, 8, 0] = 20.0   # channel 0 peak: y-index 2, x-index 8
+    features[0, 6, 1, 1] = 20.0   # channel 1 peak: y-index 6, x-index 1
+    out = np.asarray(spatial_softmax(jnp.asarray(features)))
+    assert out.shape == (1, 4)  # (x0, x1, y0, y1)
+    np.testing.assert_allclose(out[0, 0], np.linspace(-1, 1, 11)[8],
+                               atol=1e-3)
+    np.testing.assert_allclose(out[0, 1], np.linspace(-1, 1, 11)[1],
+                               atol=1e-3)
+    np.testing.assert_allclose(out[0, 2], np.linspace(-1, 1, 9)[2],
+                               atol=1e-3)
+    np.testing.assert_allclose(out[0, 3], np.linspace(-1, 1, 9)[6],
+                               atol=1e-3)
+
+  def test_conv_tower_shapes(self):
+    module = ImagesToFeatures()
+    images = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    variables = module.init(jax.random.key(0), images)
+    out = module.apply(variables, images)
+    assert out.shape == (2, 8, 8, 128)  # three stride-2 downsamples
+    assert out.dtype == jnp.bfloat16
+
+  def test_pose_head(self):
+    module = ImageFeaturesToPose(pose_dim=2)
+    feature_map = jnp.zeros((2, 8, 8, 16), jnp.float32)
+    variables = module.init(jax.random.key(0), feature_map)
+    out = module.apply(variables, feature_map)
+    assert out.shape == (2, 2)
+    assert out.dtype == jnp.float32
+
+
+class TestResNet:
+
+  @pytest.mark.parametrize("depth,expect_dim", [(18, 512), (50, 2048)])
+  def test_feature_shapes(self, depth, expect_dim):
+    module = ResNet(depth=depth, width=64)
+    images = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = module.init(jax.random.key(0), images)
+    out = module.apply(variables, images)
+    assert out.shape == (1, expect_dim)
+
+  def test_classifier_head(self):
+    module = ResNet(depth=18, width=16, num_classes=7)
+    images = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = module.init(jax.random.key(0), images)
+    out = module.apply(variables, images)
+    assert out.shape == (2, 7) and out.dtype == jnp.float32
+
+  def test_film_conditions_output(self):
+    module = FilmResNet(depth=18, width=16)
+    images = jnp.ones((2, 32, 32, 3), jnp.float32)
+    ctx1 = jnp.zeros((2, 8), jnp.float32)
+    ctx2 = jnp.ones((2, 8), jnp.float32) * 3.0
+    variables = module.init(jax.random.key(0), images, ctx1)
+    out1 = module.apply(variables, images, ctx1)
+    out2 = module.apply(variables, images, ctx2)
+    assert out1.shape == out2.shape
+    assert np.abs(np.asarray(out1) - np.asarray(out2)).max() > 1e-4
+
+  def test_film_requires_context(self):
+    module = FilmResNet(depth=18, width=16)
+    with pytest.raises(ValueError, match="context"):
+      module.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+
+  def test_batch_stats_updated_in_train(self):
+    module = ResNet(depth=18, width=16)
+    images = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = module.init(jax.random.key(0), images)
+    _, new_state = module.apply(
+        variables, images, train=True, mutable=["batch_stats"])
+    changed = jax.tree_util.tree_map(
+        lambda a, b: bool(np.any(np.asarray(a) != np.asarray(b))),
+        variables["batch_stats"], new_state["batch_stats"])
+    assert any(jax.tree_util.tree_leaves(changed))
+
+
+class TestSnail:
+
+  def test_causal_conv_is_causal(self):
+    """Perturbing input at time t must not change outputs before t."""
+    module = snail.CausalConv(features=4, kernel_size=2, dilation=2,
+                              dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).random((1, 8, 3)),
+                    jnp.float32)
+    variables = module.init(jax.random.key(0), x)
+    base = np.asarray(module.apply(variables, x))
+    perturbed = x.at[0, 5, :].add(10.0)
+    out = np.asarray(module.apply(variables, perturbed))
+    np.testing.assert_allclose(out[0, :5], base[0, :5], atol=1e-6)
+    assert np.abs(out[0, 5:] - base[0, 5:]).max() > 1e-3
+
+  def test_attention_is_causal(self):
+    module = snail.AttentionBlock(key_size=8, value_size=8,
+                                  dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(1).random((1, 6, 4)),
+                    jnp.float32)
+    variables = module.init(jax.random.key(0), x)
+    base = np.asarray(module.apply(variables, x))
+    perturbed = x.at[0, 4, :].add(10.0)
+    out = np.asarray(module.apply(variables, perturbed))
+    np.testing.assert_allclose(out[0, :4], base[0, :4], atol=1e-5)
+
+  def test_tc_block_concat_growth(self):
+    module = snail.TCBlock(seq_len=8, filters=5, dtype=jnp.float32)
+    x = jnp.zeros((2, 8, 3), jnp.float32)
+    variables = module.init(jax.random.key(0), x)
+    out = module.apply(variables, x)
+    # log2(8)=3 dense blocks, each concatenating 5 channels.
+    assert out.shape == (2, 8, 3 + 3 * 5)
+
+
+class _MdnModule(nn.Module):
+  num_components: int = 3
+  sample_size: int = 2
+
+  @nn.compact
+  def __call__(self, x):
+    return mdn.predict_mixture_params(
+        x, self.num_components, self.sample_size)
+
+
+class TestMdn:
+
+  def _params(self, batch=4):
+    module = _MdnModule()
+    x = jnp.zeros((batch, 6), jnp.float32)
+    variables = module.init(jax.random.key(0), x)
+    return module.apply(variables, x)
+
+  def test_shapes_and_normalization(self):
+    params = self._params()
+    assert params.log_alphas.shape == (4, 3)
+    assert params.mus.shape == (4, 3, 2)
+    assert params.log_sigmas.shape == (4, 3, 2)
+    np.testing.assert_allclose(
+        np.exp(np.asarray(params.log_alphas)).sum(-1), 1.0, atol=1e-5)
+
+  def test_log_prob_matches_single_gaussian(self):
+    """With one component, GMM log-prob == diagonal Gaussian log-pdf."""
+    mus = jnp.asarray([[[0.5, -0.5]]])
+    log_sigmas = jnp.asarray([[[0.1, -0.2]]])
+    params = mdn.MixtureParams(
+        log_alphas=jnp.zeros((1, 1)), mus=mus, log_sigmas=log_sigmas)
+    x = jnp.asarray([[0.3, 0.1]])
+    from scipy import stats
+    expected = stats.norm.logpdf(
+        [0.3, 0.1], loc=[0.5, -0.5],
+        scale=np.exp([0.1, -0.2])).sum()
+    np.testing.assert_allclose(
+        float(mdn.log_prob(params, x)[0]), expected, rtol=1e-5)
+
+  def test_approximate_mode(self):
+    params = mdn.MixtureParams(
+        log_alphas=jnp.log(jnp.asarray([[0.1, 0.7, 0.2]])),
+        mus=jnp.asarray([[[1., 1.], [2., 3.], [4., 5.]]]),
+        log_sigmas=jnp.zeros((1, 3, 2)))
+    mode = np.asarray(mdn.gaussian_mixture_approximate_mode(params))
+    np.testing.assert_array_equal(mode, [[2., 3.]])
+
+  def test_nll_gradient_training(self):
+    """Fitting a 2-component MDN to a bimodal target reduces NLL."""
+    import optax
+    module = _MdnModule(num_components=2, sample_size=1)
+    rng = np.random.default_rng(0)
+    # Nonzero inputs: with all-zero features both components are bias-only
+    # and exactly symmetric, so gradients can never split them.
+    x = jnp.asarray(rng.standard_normal((256, 4)), jnp.float32)
+    targets = jnp.asarray(
+        np.where(rng.random((256, 1)) < 0.5, -2.0, 2.0)
+        + 0.1 * rng.standard_normal((256, 1)), jnp.float32)
+    variables = module.init(jax.random.key(0), x)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(variables)
+
+    @jax.jit
+    def step(variables, opt_state):
+      def loss_fn(v):
+        params = module.apply(v, x)
+        return mdn.negative_log_likelihood(params, targets)
+      loss, grads = jax.value_and_grad(loss_fn)(variables)
+      updates, opt_state = opt.update(grads, opt_state)
+      return optax.apply_updates(variables, updates), opt_state, loss
+
+    first = None
+    for _ in range(600):
+      variables, opt_state, loss = step(variables, opt_state)
+      if first is None:
+        first = float(loss)
+    assert float(loss) < first
+    # The two components should land near the two modes.
+    params = module.apply(variables, x)
+    mus = np.sort(np.asarray(params.mus).mean(axis=0).ravel())
+    np.testing.assert_allclose(mus, [-2.0, 2.0], atol=0.5)
+
+  def test_sample_shape(self):
+    params = self._params()
+    s = mdn.sample(params, jax.random.key(0))
+    assert s.shape == (4, 2)
