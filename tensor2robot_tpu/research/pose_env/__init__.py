@@ -1,0 +1,8 @@
+"""pose_env: the minimal end-to-end demo task (SURVEY.md §2, BASELINE #1)."""
+
+from tensor2robot_tpu.research.pose_env.pose_env import PoseEnv, PoseToyEnv
+from tensor2robot_tpu.research.pose_env.pose_env_models import (
+    PoseEnvRegressionModel,
+)
+
+__all__ = ["PoseEnv", "PoseToyEnv", "PoseEnvRegressionModel"]

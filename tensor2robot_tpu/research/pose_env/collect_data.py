@@ -1,0 +1,27 @@
+"""Random-policy data collection → TFRecords (reference parity:
+research/pose_env data-collection main, SURVEY.md §2)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main(argv=None) -> int:
+  from tensor2robot_tpu.research.pose_env import pose_env
+
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument("--output", required=True)
+  parser.add_argument("--episodes", type=int, default=1000)
+  parser.add_argument("--seed", type=int, default=0)
+  args = parser.parse_args(argv)
+
+  os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
+  path = pose_env.write_tfrecords(
+      args.output, num_episodes=args.episodes, seed=args.seed)
+  print(f"Wrote {args.episodes} episodes to {path}")
+  return 0
+
+
+if __name__ == "__main__":
+  raise SystemExit(main())

@@ -1,0 +1,149 @@
+"""PoseEnv: simulated planar reaching — predict target pose from camera.
+
+Reference parity: research/pose_env/pose_env.py §PoseEnv/PoseToyEnv
+(SURVEY.md §2): a PyBullet table-top reaching task used as the
+reference's own smoke-test workload — random-policy episodes are
+collected to TFRecords, a tiny conv net regresses the 2D target pose
+from the rendered camera image, and success is reaching within a
+threshold. PyBullet is not in this image, so the sim is a self-contained
+numpy renderer with identical observable structure: RGB camera image of
+a table with a colored target object, 2D action in table coordinates,
+negative-distance reward. The learning problem (image → pose) is the
+same; only the rasterizer differs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+IMAGE_SIZE = 64
+TABLE_COLOR = (96, 72, 48)
+TARGET_COLOR = (200, 40, 40)
+ARM_COLOR = (60, 60, 180)
+
+
+@dataclasses.dataclass
+class PoseEnvStep:
+  observation: Dict[str, np.ndarray]
+  reward: float
+  done: bool
+  info: Dict
+
+
+class PoseEnv:
+  """Single-step reaching: observe image, act with a 2D pose."""
+
+  def __init__(self, image_size: int = IMAGE_SIZE, seed: int = 0,
+               success_threshold: float = 0.1):
+    self._image_size = image_size
+    self._rng = np.random.default_rng(seed)
+    self._success_threshold = success_threshold
+    self._target: Optional[np.ndarray] = None
+
+  # --- gym-ish API ---------------------------------------------------------
+
+  def reset(self) -> Dict[str, np.ndarray]:
+    """New episode: target placed uniformly in [-1, 1]^2 table coords."""
+    self._target = self._rng.uniform(-0.8, 0.8, size=2).astype(np.float32)
+    return self._observation()
+
+  def step(self, action: np.ndarray) -> PoseEnvStep:
+    """Act with a 2D pose; reward = −distance to target; episode ends."""
+    if self._target is None:
+      raise RuntimeError("Call reset() first.")
+    action = np.asarray(action, np.float32)
+    distance = float(np.linalg.norm(action - self._target))
+    step = PoseEnvStep(
+        observation=self._observation(),
+        reward=-distance,
+        done=True,
+        info={"success": distance < self._success_threshold,
+              "target_pose": self._target.copy()},
+    )
+    return step
+
+  @property
+  def target_pose(self) -> np.ndarray:
+    if self._target is None:
+      raise RuntimeError("Call reset() first.")
+    return self._target
+
+  # --- rendering -----------------------------------------------------------
+
+  def _observation(self) -> Dict[str, np.ndarray]:
+    return {"image": self.render(), "target_pose": self._target.copy()}
+
+  def render(self) -> np.ndarray:
+    """Rasterizes the table scene: uint8 (S, S, 3)."""
+    s = self._image_size
+    image = np.empty((s, s, 3), np.uint8)
+    image[:] = TABLE_COLOR
+    # Checker shading for texture so the conv net sees gradients.
+    yy, xx = np.mgrid[0:s, 0:s]
+    image[((yy // 8 + xx // 8) % 2).astype(bool)] = tuple(
+        min(c + 12, 255) for c in TABLE_COLOR)
+    # Arm base: fixed blue disc at the bottom center.
+    self._draw_disc(image, (0.0, -0.95), radius=0.12, color=ARM_COLOR)
+    # Target: red disc at the target pose.
+    self._draw_disc(image, tuple(self._target), radius=0.1,
+                    color=TARGET_COLOR)
+    return image
+
+  def _draw_disc(self, image: np.ndarray, center_xy: Tuple[float, float],
+                 radius: float, color) -> None:
+    s = self._image_size
+    cx = (center_xy[0] + 1.0) / 2.0 * (s - 1)
+    cy = (1.0 - (center_xy[1] + 1.0) / 2.0) * (s - 1)
+    r = radius / 2.0 * (s - 1)
+    yy, xx = np.mgrid[0:s, 0:s]
+    mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= r ** 2
+    image[mask] = color
+
+
+# Reference alias (SURVEY.md names both).
+PoseToyEnv = PoseEnv
+
+
+def collect_episodes(
+    num_episodes: int,
+    seed: int = 0,
+    image_size: int = IMAGE_SIZE,
+) -> Tuple[np.ndarray, np.ndarray]:
+  """Random-policy data collection: (images, target_poses)."""
+  env = PoseEnv(image_size=image_size, seed=seed)
+  images = np.empty((num_episodes, image_size, image_size, 3), np.uint8)
+  poses = np.empty((num_episodes, 2), np.float32)
+  for i in range(num_episodes):
+    obs = env.reset()
+    images[i] = obs["image"]
+    poses[i] = obs["target_pose"]
+  return images, poses
+
+
+def write_tfrecords(path: str, num_episodes: int, seed: int = 0,
+                    image_size: int = IMAGE_SIZE) -> str:
+  """Collects episodes and writes the reference-format TFRecord file:
+  tf.Examples with a jpeg-encoded image and a float target pose."""
+  import io
+
+  from PIL import Image
+
+  from tensor2robot_tpu.data import example_proto, tfrecord
+
+  images, poses = collect_episodes(num_episodes, seed=seed,
+                                   image_size=image_size)
+
+  def records():
+    for image, pose in zip(images, poses):
+      buf = io.BytesIO()
+      Image.fromarray(image).save(buf, format="JPEG", quality=95)
+      yield example_proto.encode_example({
+          "image": [buf.getvalue()],
+          "target_pose": pose.tolist(),
+      })
+
+  tfrecord.write_tfrecords(path, records())
+  return path

@@ -1,0 +1,102 @@
+"""Pose-env models: tiny conv regression from camera image to 2D pose.
+
+Reference parity: research/pose_env/pose_env_models.py
+§PoseEnvRegressionModel (SURVEY.md §2): conv tower → spatial softmax →
+FC → 2D pose, MSE to the target pose; CPU-runnable in seconds. This is
+BASELINE config #1 and the framework's end-to-end slice (§7.6).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.layers.vision_layers import (
+    ImageFeaturesToPose,
+    ImagesToFeatures,
+)
+from tensor2robot_tpu.models.regression_model import RegressionModel
+from tensor2robot_tpu.preprocessors.image_preprocessors import (
+    ImagePreprocessor,
+)
+from tensor2robot_tpu.research.pose_env.pose_env import IMAGE_SIZE
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class _PoseEnvModule(nn.Module):
+  """Conv tower → spatial softmax → pose head."""
+
+  pose_dim: int = 2
+  compute_dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, features, mode: str):
+    train = mode == modes.TRAIN
+    feature_map = ImagesToFeatures(
+        filters=(32, 48, 64), strides=(2, 2, 1),
+        dtype=self.compute_dtype, name="tower")(
+            features["image"], train=train)
+    pose = ImageFeaturesToPose(
+        pose_dim=self.pose_dim, hidden_sizes=(64,),
+        dtype=self.compute_dtype, name="head")(feature_map, train=train)
+    return ts.TensorSpecStruct({"inference_output": pose})
+
+
+@configurable
+class PoseEnvRegressionModel(RegressionModel):
+  """Image → 2D target pose (MSE)."""
+
+  def __init__(self, image_size: int = IMAGE_SIZE,
+               in_image_size: Optional[int] = None, distort: bool = False,
+               **kwargs):
+    super().__init__(label_key="target_pose", **kwargs)
+    self._image_size = image_size
+    self._in_image_size = in_image_size or image_size
+    self._distort = distort
+
+  def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return ts.TensorSpecStruct({
+        "image": ts.ExtendedTensorSpec(
+            (self._image_size, self._image_size, 3), np.float32,
+            name="image"),
+    })
+
+  def get_label_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return ts.TensorSpecStruct({
+        "target_pose": ts.ExtendedTensorSpec((2,), np.float32,
+                                             name="target_pose"),
+    })
+
+  def create_preprocessor(self):
+    """Parses jpeg-encoded images at the collection size, converts to
+    model-ready float (train-mode crop/distort per ImagePreprocessor)."""
+    return ImagePreprocessor(
+        feature_spec=self.get_feature_specification(modes.TRAIN),
+        label_spec=self.get_label_specification(modes.TRAIN),
+        image_key="image",
+        in_image_shape=(self._in_image_size, self._in_image_size, 3),
+        data_format="jpeg",
+        distort=self._distort,
+    )
+
+  def build_module(self) -> nn.Module:
+    return _PoseEnvModule(compute_dtype=self.compute_dtype)
+
+  def loss_fn(self, outputs, features, labels
+              ) -> Tuple[jnp.ndarray, dict]:
+    predictions = outputs["inference_output"]
+    target = labels["target_pose"]
+    loss = jnp.mean(jnp.square(predictions - target))
+    metrics = {
+        "mse": loss,
+        "mean_pose_error": jnp.mean(
+            jnp.linalg.norm(predictions - target, axis=-1)),
+    }
+    return loss, metrics

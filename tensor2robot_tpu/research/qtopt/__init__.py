@@ -1,0 +1,6 @@
+"""QT-Opt grasping: the BASELINE north-star workload (SURVEY.md §2)."""
+
+from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
+from tensor2robot_tpu.research.qtopt import cem
+
+__all__ = ["QTOptGraspingModel", "cem"]

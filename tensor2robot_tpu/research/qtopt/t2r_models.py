@@ -1,0 +1,146 @@
+"""QT-Opt grasping Q-function — the legacy grasping net, TPU-first.
+
+Reference parity: research/qtopt/t2r_models.py §LegacyGraspingModelQ /
+grasping Q-model (SURVEY.md §2): conv tower over a 472×472 camera image;
+the action/state vector is embedded with FCs, tiled over the spatial map
+and merged into the tower mid-way; more convs → FC → sigmoid Q ∈ [0,1];
+cross-entropy loss against Bellman-target labels (produced off-repo by
+the QT-Opt Bellman updater — SURVEY.md notes that fleet is not part of
+the reference either). CEM action optimization at serving lives in
+research/qtopt/cem.py.
+
+TPU design notes:
+  - The whole net is static-shape NHWC bfloat16; the stem uses strided
+    convs + max-pool to collapse 472² to 59² quickly, putting >90% of
+    FLOPs in MXU-friendly 3×3 convs at modest spatial sizes.
+  - Action merge is add-after-projection (FiLM-lite): tile-free
+    broadcast of a (B, 1, 1, C) embedding, fusing into the surrounding
+    convs under XLA instead of materializing a tiled tensor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.models.critic_model import CriticModel
+from tensor2robot_tpu.preprocessors.image_preprocessors import (
+    ImagePreprocessor,
+)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+IMAGE_SIZE = 472
+ACTION_SIZE = 4  # cartesian displacement (3) + gripper command (1)
+
+
+class _GraspingQModule(nn.Module):
+  """The legacy grasping net as one Flax module."""
+
+  action_size: int = ACTION_SIZE
+  compute_dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, features, mode: str):
+    train = mode == modes.TRAIN
+    dtype = self.compute_dtype
+    norm = lambda name: nn.BatchNorm(
+        use_running_average=not train, dtype=dtype, name=name)
+
+    x = features["image"].astype(dtype)
+    # Stem: 472 -> 118 -> 59.
+    x = nn.relu(norm("stem_bn")(nn.Conv(
+        64, (6, 6), strides=(4, 4), dtype=dtype, name="stem")(x)))
+    x = nn.max_pool(x, (2, 2), strides=(2, 2))
+    for i in range(3):
+      x = nn.relu(norm(f"pre_bn{i}")(nn.Conv(
+          64, (3, 3), dtype=dtype, name=f"pre_conv{i}")(x)))
+
+    # Action (and optional state vector) merge.
+    action = features["action"].astype(dtype)
+    if action.shape[-1] != self.action_size:
+      raise ValueError(
+          f"Expected action dim {self.action_size}, got "
+          f"{action.shape[-1]}.")
+    merge_inputs = [action]
+    if "state" in features:
+      merge_inputs.append(features["state"].astype(dtype))
+    embedding = jnp.concatenate(merge_inputs, axis=-1)
+    embedding = nn.relu(nn.Dense(64, dtype=dtype, name="action_fc1")(
+        embedding))
+    embedding = nn.Dense(64, dtype=dtype, name="action_fc2")(embedding)
+    x = nn.relu(x + embedding[:, None, None, :])
+
+    # Post-merge tower: 59 -> 29 -> 14 -> 7.
+    for i, stride in enumerate((2, 2, 2)):
+      x = nn.relu(norm(f"post_bn{i}")(nn.Conv(
+          64, (3, 3), strides=(stride, stride), dtype=dtype,
+          name=f"post_conv{i}")(x)))
+
+    x = jnp.mean(x, axis=(1, 2))  # global pool → (B, 64)
+    x = nn.relu(nn.Dense(64, dtype=dtype, name="fc1")(x))
+    q_logit = nn.Dense(1, dtype=jnp.float32, name="q_head")(x)[:, 0]
+    return ts.TensorSpecStruct({"q_predicted": q_logit})
+
+
+@configurable
+class QTOptGraspingModel(CriticModel):
+  """(image, action) → grasp-success Q, cross-entropy vs Bellman target."""
+
+  # bench.py reads this: the per-chip benchmark batch.
+  benchmark_batch_size = 32
+
+  def __init__(self, image_size: int = IMAGE_SIZE,
+               in_image_size: Optional[int] = None,
+               action_size: int = ACTION_SIZE,
+               state_size: int = 0,
+               distort: bool = False,
+               **kwargs):
+    """state_size > 0 adds a proprioceptive `state` vector feature
+    (gripper status etc., reference's non-image state)."""
+    super().__init__(**kwargs)
+    self._image_size = image_size
+    self._in_image_size = in_image_size or image_size
+    self._action_size = action_size
+    self._state_size = state_size
+    self._distort = distort
+
+  def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    spec = ts.TensorSpecStruct({
+        "image": ts.ExtendedTensorSpec(
+            (self._image_size, self._image_size, 3), np.float32,
+            name="image"),
+        "action": ts.ExtendedTensorSpec(
+            (self._action_size,), np.float32, name="action"),
+    })
+    if self._state_size:
+      spec["state"] = ts.ExtendedTensorSpec(
+          (self._state_size,), np.float32, name="state")
+    return spec
+
+  def get_label_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return ts.TensorSpecStruct({
+        self.target_key: ts.ExtendedTensorSpec(
+            (), np.float32, name=self.target_key),
+    })
+
+  def create_preprocessor(self):
+    return ImagePreprocessor(
+        feature_spec=self.get_feature_specification(modes.TRAIN),
+        label_spec=self.get_label_specification(modes.TRAIN),
+        image_key="image",
+        in_image_shape=(self._in_image_size, self._in_image_size, 3),
+        data_format="jpeg",
+        distort=self._distort,
+    )
+
+  def build_module(self) -> nn.Module:
+    return _GraspingQModule(
+        action_size=self._action_size,
+        compute_dtype=self.compute_dtype)
