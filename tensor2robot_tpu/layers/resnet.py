@@ -97,6 +97,7 @@ class ResNet(nn.Module):
   width: int = 64
   num_classes: int = 0
   film: bool = False
+  return_spatial: bool = False  # also return the pre-pool feature map
   dtype: Any = jnp.bfloat16
 
   @nn.compact
@@ -129,8 +130,10 @@ class ResNet(nn.Module):
 
     features = jnp.mean(x, axis=(1, 2))  # global average pool
     if self.num_classes:
-      return nn.Dense(self.num_classes, dtype=jnp.float32,
-                      name="classifier")(features)
+      features = nn.Dense(self.num_classes, dtype=jnp.float32,
+                          name="classifier")(features)
+    if self.return_spatial:
+      return features, x
     return features
 
 

@@ -1,0 +1,9 @@
+"""Meta-learning: MAML as a model transformer (SURVEY.md §2, §3.5)."""
+
+from tensor2robot_tpu.meta_learning.maml_model import MAMLModel
+from tensor2robot_tpu.meta_learning.meta_data import (
+    meta_batch_from_arrays,
+    multi_batch_apply,
+)
+
+__all__ = ["MAMLModel", "meta_batch_from_arrays", "multi_batch_apply"]

@@ -1,0 +1,219 @@
+"""MAMLModel — model-agnostic meta-learning as a model transformer.
+
+Reference parity: meta_learning/maml_model.py §MAMLModel +
+meta_learning/maml_inner_loop.py §MAMLInnerLoopGradientDescent
+(SURVEY.md §2, §3.5). The reference unrolled K functional gradient steps
+in-graph with tf.gradients and manual weight substitution; in JAX the
+same contraption is `jax.grad` over a functional inner loop, vmapped
+over the task batch — second-order gradients come for free from the
+outer differentiation (SURVEY.md §3.5 rebuild note).
+
+Input layout (flat TensorSpecStruct keys, batch dim = tasks):
+    condition/features/*  (B, N_c, ...)   support inputs
+    condition/labels/*    (B, N_c, ...)   support targets
+    inference/features/*  (B, N_q, ...)   query inputs
+    inference/labels/*    (B, N_q, ...)   query targets
+built by meta_data.meta_batch_from_arrays (reference §MetaExample).
+
+Notes:
+  - Batch-norm statistics are NOT adapted in the inner loop (running
+    state is read-only during adaptation, updates discarded) — matching
+    the reference, whose inner loop only substituted weights.
+  - PREDICT performs the same adapt-then-forward: meta-serving requires
+    condition data in the request, as in the reference's meta predictors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, Metrics
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+def _subtree(struct, prefix: str) -> ts.TensorSpecStruct:
+  flat = ts.flatten_spec_structure(struct)
+  out = ts.TensorSpecStruct()
+  for key, value in flat.items():
+    if key.startswith(prefix + "/"):
+      out[key[len(prefix) + 1:]] = value
+  return out
+
+
+@configurable
+class MAMLModel(AbstractT2RModel):
+  """Wraps any AbstractT2RModel with a MAML inner/outer loop."""
+
+  def __init__(
+      self,
+      base_model: AbstractT2RModel,
+      num_inner_steps: int = 1,
+      inner_lr: float = 0.01,
+      learn_inner_lr: bool = False,
+      first_order: bool = False,
+      num_condition_samples: int = 4,
+      num_inference_samples: int = 4,
+      **kwargs,
+  ):
+    """Args (reference §MAMLModel / §MAMLInnerLoopGradientDescent):
+      base_model: the task model being meta-learned.
+      num_inner_steps: K unrolled adaptation steps.
+      inner_lr: initial (or fixed) inner-loop step size.
+      learn_inner_lr: meta-learn one step size per parameter leaf
+        (the reference's learned per-layer inner LRs).
+      first_order: stop gradients through the inner-loop gradients
+        (FOMAML) — cheaper, usually nearly as good.
+      num_condition_samples / num_inference_samples: per-task split
+        sizes declared in the feature specs.
+    """
+    kwargs.setdefault("compute_dtype", base_model.compute_dtype)
+    super().__init__(**kwargs)
+    self.base_model = base_model
+    self.num_inner_steps = num_inner_steps
+    self.inner_lr = inner_lr
+    self.learn_inner_lr = learn_inner_lr
+    self.first_order = first_order
+    self.num_condition_samples = num_condition_samples
+    self.num_inference_samples = num_inference_samples
+
+  # --- specs ---------------------------------------------------------------
+
+  def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
+    base_f = ts.flatten_spec_structure(
+        self.base_model.preprocessor.get_out_feature_specification(mode))
+    base_l = ts.flatten_spec_structure(
+        self.base_model.preprocessor.get_out_label_specification(mode))
+    out = ts.TensorSpecStruct()
+    for name, count in (("condition", self.num_condition_samples),
+                        ("inference", self.num_inference_samples)):
+      for key, spec in base_f.items():
+        out[f"{name}/features/{key}"] = ts.ExtendedTensorSpec.from_spec(
+            spec, shape=(count,) + spec.shape)
+      for key, spec in base_l.items():
+        out[f"{name}/labels/{key}"] = ts.ExtendedTensorSpec.from_spec(
+            spec, shape=(count,) + spec.shape)
+    return out
+
+  def get_label_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return ts.TensorSpecStruct()  # query labels travel inside features
+
+  # --- variables -----------------------------------------------------------
+
+  def build_module(self) -> nn.Module:
+    return self.base_model.module
+
+  def init_variables(self, rng: jax.Array, batch_size: int = 1,
+                     mode: str = modes.TRAIN) -> Dict[str, Any]:
+    del batch_size
+    variables = dict(self.base_model.init_variables(
+        rng, batch_size=self.num_condition_samples, mode=mode))
+    if self.learn_inner_lr:
+      base_params = variables.pop("params")
+      inner_lrs = jax.tree_util.tree_map(
+          lambda _: jnp.asarray(self.inner_lr, jnp.float32), base_params)
+      variables["params"] = {"base": base_params, "inner_lrs": inner_lrs}
+    return variables
+
+  def _split_params(self, params):
+    if self.learn_inner_lr:
+      return params["base"], params["inner_lrs"]
+    return params, None
+
+  # --- the MAML computation ------------------------------------------------
+
+  def inference_network_fn(
+      self,
+      variables,
+      features: ts.TensorSpecStruct,
+      mode: str,
+      rngs: Optional[Dict[str, jax.Array]] = None,
+  ) -> Tuple[Any, Dict[str, Any]]:
+    base = self.base_model
+    params = variables["params"]
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+    base_params, inner_lrs = self._split_params(params)
+
+    cond_f = _subtree(features, "condition/features")
+    cond_l = _subtree(features, "condition/labels")
+    query_f = _subtree(features, "inference/features")
+
+    dropout_rng = (rngs or {}).get("dropout")
+
+    mutable = (list(base.mutable_collections())
+               if mode == modes.TRAIN else [])
+
+    def apply_base(p, f, step_rng):
+      variables_b = {"params": p, **model_state}
+      base_rngs = {"dropout": step_rng} if step_rng is not None else None
+      if mutable:
+        # Batch-norm etc. may write during the train-mode forward, but
+        # the inner loop never adapts state: updates are discarded.
+        outputs, _ = base.module.apply(
+            variables_b, f, mode, rngs=base_rngs, mutable=mutable)
+        return outputs
+      return base.module.apply(variables_b, f, mode, rngs=base_rngs)
+
+    def support_loss(p, f, l, step_rng):
+      outputs = apply_base(p, f, step_rng)
+      loss, _ = base.loss_fn(outputs, f, l)
+      return loss
+
+    lr_tree = (inner_lrs if inner_lrs is not None else
+               jax.tree_util.tree_map(lambda _: self.inner_lr, base_params))
+
+    def single_task(cf, cl, qf, task_rng):
+      p = base_params
+      final_support_loss = jnp.float32(0)
+      for k in range(self.num_inner_steps):  # unrolled, like the reference
+        step_rng = (jax.random.fold_in(task_rng, k)
+                    if task_rng is not None else None)
+        loss_k, grads = jax.value_and_grad(support_loss)(p, cf, cl,
+                                                         step_rng)
+        if self.first_order:
+          grads = jax.lax.stop_gradient(grads)
+        p = jax.tree_util.tree_map(
+            lambda pp, g, lr: pp - lr * g, p, grads, lr_tree)
+        final_support_loss = loss_k
+      query_rng = (jax.random.fold_in(task_rng, self.num_inner_steps)
+                   if task_rng is not None else None)
+      query_outputs = apply_base(p, qf, query_rng)
+      return query_outputs, final_support_loss
+
+    num_tasks = jax.tree_util.tree_leaves(cond_f)[0].shape[0]
+    task_rngs = (jax.random.split(dropout_rng, num_tasks)
+                 if dropout_rng is not None else None)
+    if task_rngs is not None:
+      query_outputs, support_losses = jax.vmap(single_task)(
+          cond_f, cond_l, query_f, task_rngs)
+    else:
+      query_outputs, support_losses = jax.vmap(
+          lambda cf, cl, qf: single_task(cf, cl, qf, None))(
+              cond_f, cond_l, query_f)
+    outputs = ts.TensorSpecStruct(query_outputs)
+    outputs["condition_loss"] = support_losses
+    # Pass model_state through unchanged (never adapted, never dropped —
+    # returning {} here would wipe batch_stats out of the TrainState).
+    return outputs, model_state
+
+  def mutable_collections(self) -> Tuple[str, ...]:
+    return ()  # inner loop is stateless; BN state is read-only here
+
+  def loss_fn(self, outputs, features, labels) -> Tuple[jnp.ndarray, Metrics]:
+    del labels
+    query_labels = _subtree(features, "inference/labels")
+    base_outputs = ts.TensorSpecStruct(
+        (k, v) for k, v in outputs.items() if k != "condition_loss")
+    query_features = _subtree(features, "inference/features")
+    loss, metrics = self.base_model.loss_fn(
+        base_outputs, query_features, query_labels)
+    metrics = dict(metrics)
+    metrics["outer_loss"] = loss
+    metrics["inner_loss_final"] = jnp.mean(outputs["condition_loss"])
+    return loss, metrics

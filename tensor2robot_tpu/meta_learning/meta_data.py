@@ -1,0 +1,91 @@
+"""Meta-batched data utilities.
+
+Reference parity: meta_learning/meta_tfdata.py §multi_batch_apply and
+meta_learning/meta_example.py §MetaExample (SURVEY.md §2): handling
+(task_batch, samples_per_task, ...) nested batches and converting
+per-task example pools into condition/inference meta-batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+def multi_batch_apply(fn: Callable, num_batch_dims: int, *arrays: Any,
+                      **kwargs) -> Any:
+  """Applies `fn` with the leading `num_batch_dims` dims merged into one.
+
+  The reference used this to push (task, sample, ...) tensors through
+  ops expecting a single batch dim; in JAX it remains useful for host
+  pipelines and non-vmapped transforms.
+  """
+  import jax
+
+  leaves = jax.tree_util.tree_leaves(arrays)
+  if not leaves:
+    return fn(*arrays, **kwargs)
+  lead = leaves[0].shape[:num_batch_dims]
+
+  def merge(x):
+    return x.reshape((-1,) + tuple(x.shape[num_batch_dims:]))
+
+  def split(x):
+    return x.reshape(lead + tuple(x.shape[1:]))
+
+  merged = jax.tree_util.tree_map(merge, arrays)
+  out = fn(*merged, **kwargs)
+  return jax.tree_util.tree_map(split, out)
+
+
+def meta_batch_from_arrays(
+    features_per_task: ts.TensorSpecStruct,
+    labels_per_task: ts.TensorSpecStruct,
+    num_condition_samples: int,
+    num_inference_samples: int,
+    rng: Optional[np.random.Generator] = None,
+) -> ts.TensorSpecStruct:
+  """Builds one MAML meta-feature struct from per-task sample pools.
+
+  Args:
+    features_per_task / labels_per_task: flat structs of arrays shaped
+      (num_tasks, pool_size, ...).
+    num_condition_samples / num_inference_samples: split sizes (pool must
+      hold at least their sum).
+    rng: optional shuffler of the per-task pool before splitting.
+
+  Returns:
+    Flat struct with condition/features/*, condition/labels/*,
+    inference/features/*, inference/labels/* — the MAMLModel input
+    layout (reference §MetaExample).
+  """
+  flat_features = ts.flatten_spec_structure(features_per_task)
+  flat_labels = ts.flatten_spec_structure(labels_per_task)
+  any_leaf = next(iter(flat_features.values()))
+  num_tasks, pool = any_leaf.shape[:2]
+  need = num_condition_samples + num_inference_samples
+  if pool < need:
+    raise ValueError(
+        f"Per-task pool of {pool} samples cannot supply "
+        f"{num_condition_samples}+{num_inference_samples}.")
+  if rng is not None:
+    order = np.stack([rng.permutation(pool) for _ in range(num_tasks)])
+  else:
+    order = np.broadcast_to(np.arange(pool), (num_tasks, pool))
+  cond_idx = order[:, :num_condition_samples]
+  inf_idx = order[:, num_condition_samples:need]
+
+  def gather(array, idx):
+    return np.stack([array[t][idx[t]] for t in range(num_tasks)])
+
+  out = ts.TensorSpecStruct()
+  for key, value in flat_features.items():
+    out[f"condition/features/{key}"] = gather(value, cond_idx)
+    out[f"inference/features/{key}"] = gather(value, inf_idx)
+  for key, value in flat_labels.items():
+    out[f"condition/labels/{key}"] = gather(value, cond_idx)
+    out[f"inference/labels/{key}"] = gather(value, inf_idx)
+  return out

@@ -1,0 +1,8 @@
+"""Grasp2Vec: self-supervised object embeddings (SURVEY.md §2, BASELINE #2)."""
+
+from tensor2robot_tpu.research.grasp2vec.grasp2vec_model import (
+    Grasp2VecModel,
+)
+from tensor2robot_tpu.research.grasp2vec import losses, visualization
+
+__all__ = ["Grasp2VecModel", "losses", "visualization"]

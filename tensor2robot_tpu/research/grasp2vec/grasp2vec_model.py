@@ -1,0 +1,108 @@
+"""Grasp2VecModel: φ(scene_pre) − φ(scene_post) ≈ φ(outcome).
+
+Reference parity: research/grasp2vec/grasp2vec_model.py +
+networks.py (SURVEY.md §2): ResNet-50 feature towers over
+(scene_pre, scene_post, outcome) images — one shared scene tower, one
+outcome tower — trained with n-pairs loss on the embedding arithmetic.
+BASELINE config #2.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.layers.resnet import ResNet
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, Metrics
+from tensor2robot_tpu.preprocessors.image_preprocessors import (
+    ImagePreprocessor,
+)
+from tensor2robot_tpu.research.grasp2vec import losses
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+IMAGE_SIZE = 224
+EMBEDDING_SIZE = 512
+
+
+class _Grasp2VecModule(nn.Module):
+  """Scene tower (shared pre/post) + outcome tower → embeddings."""
+
+  depth: int = 50
+  embedding_size: int = EMBEDDING_SIZE
+  compute_dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, features, mode: str):
+    train = mode == modes.TRAIN
+    scene_tower = ResNet(depth=self.depth, return_spatial=True,
+                         dtype=self.compute_dtype, name="scene_tower")
+    outcome_tower = ResNet(depth=self.depth,
+                           dtype=self.compute_dtype, name="outcome_tower")
+    project = nn.Dense(self.embedding_size, dtype=jnp.float32,
+                       name="scene_proj")
+    out_project = nn.Dense(self.embedding_size, dtype=jnp.float32,
+                           name="outcome_proj")
+
+    pre_features, pre_map = scene_tower(features["pre_image"], train=train)
+    post_features, _ = scene_tower(features["post_image"], train=train)
+    outcome_features = outcome_tower(features["goal_image"], train=train)
+
+    pre_emb = project(pre_features.astype(jnp.float32))
+    post_emb = project(post_features.astype(jnp.float32))
+    outcome_emb = out_project(outcome_features.astype(jnp.float32))
+    return ts.TensorSpecStruct({
+        "pre_embedding": pre_emb,
+        "post_embedding": post_emb,
+        "outcome_embedding": outcome_emb,
+        "inference_output": pre_emb - post_emb,
+        # Pre-pool scene map (projected) for localization heatmaps.
+        "scene_spatial": project(
+            pre_map.astype(jnp.float32)),
+    })
+
+
+@configurable
+class Grasp2VecModel(AbstractT2RModel):
+  """Self-supervised object-embedding model (no labels)."""
+
+  def __init__(self, image_size: int = IMAGE_SIZE, depth: int = 50,
+               embedding_size: int = EMBEDDING_SIZE,
+               l2_reg: float = 2e-3, **kwargs):
+    super().__init__(**kwargs)
+    self._image_size = image_size
+    self._depth = depth
+    self._embedding_size = embedding_size
+    self._l2_reg = l2_reg
+
+  def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    image = lambda name: ts.ExtendedTensorSpec(
+        (self._image_size, self._image_size, 3), np.float32, name=name)
+    return ts.TensorSpecStruct({
+        "pre_image": image("pre_image"),
+        "post_image": image("post_image"),
+        "goal_image": image("goal_image"),
+    })
+
+  # Preprocessor: base-class default (ModelNoOpPreprocessor) — parsing
+  # uses the raw float specs; multi-image jpeg decode happens in the
+  # record pipeline.
+
+  def build_module(self) -> nn.Module:
+    return _Grasp2VecModule(
+        depth=self._depth,
+        embedding_size=self._embedding_size,
+        compute_dtype=self.compute_dtype)
+
+  def loss_fn(self, outputs, features, labels
+              ) -> Tuple[jnp.ndarray, Metrics]:
+    del features, labels  # self-supervised
+    loss, accuracy = losses.npairs_loss(
+        outputs["inference_output"], outputs["outcome_embedding"],
+        l2_reg=self._l2_reg)
+    return loss, {"npairs": loss, "retrieval_accuracy": accuracy}

@@ -1,0 +1,42 @@
+"""Grasp2Vec losses: n-pairs metric learning.
+
+Reference parity: research/grasp2vec/losses.py (SURVEY.md §2) — the
+reference used tf.contrib n-pairs loss on (φ(pre)−φ(post), φ(outcome))
+pairs with L2 embedding regularization.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import optax
+
+
+def npairs_loss(
+    anchors: jnp.ndarray,
+    positives: jnp.ndarray,
+    l2_reg: float = 2e-3,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """N-pairs loss: each anchor's positive is the same-index row; every
+  other row in the batch is its negative.
+
+  Args:
+    anchors: (B, D) embeddings (here φ(pre) − φ(post)).
+    positives: (B, D) embeddings (here φ(outcome)).
+    l2_reg: weight of the mean-squared-embedding regularizer (the
+      tf.contrib npairs `reg_lambda` semantics).
+
+  Returns:
+    (loss, accuracy): scalar loss and batch retrieval accuracy.
+  """
+  anchors = anchors.astype(jnp.float32)
+  positives = positives.astype(jnp.float32)
+  logits = anchors @ positives.T  # (B, B) similarity
+  labels = jnp.arange(anchors.shape[0])
+  ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+  reg = jnp.mean(jnp.sum(jnp.square(anchors), -1)) + jnp.mean(
+      jnp.sum(jnp.square(positives), -1))
+  accuracy = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(
+      jnp.float32))
+  return ce.mean() + l2_reg * reg, accuracy

@@ -1,0 +1,42 @@
+"""Grasp2Vec heatmap visualization.
+
+Reference parity: research/grasp2vec/visualization.py
+§add_heatmap_summary (SURVEY.md §2): localize an object instance by
+correlating its outcome embedding with the scene's spatial feature map.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def embedding_heatmap(scene_spatial: jnp.ndarray,
+                      query_embedding: jnp.ndarray) -> jnp.ndarray:
+  """Spatial similarity map between a query embedding and scene features.
+
+  Args:
+    scene_spatial: (B, H, W, D) projected scene feature map
+      (Grasp2Vec outputs["scene_spatial"]).
+    query_embedding: (B, D) object embedding to localize.
+
+  Returns:
+    (B, H, W) softmax-normalized heatmap.
+  """
+  import jax.nn
+  logits = jnp.einsum("bhwd,bd->bhw",
+                      scene_spatial.astype(jnp.float32),
+                      query_embedding.astype(jnp.float32))
+  b, h, w = logits.shape
+  probs = jax.nn.softmax(logits.reshape(b, h * w), axis=-1)
+  return probs.reshape(b, h, w)
+
+
+def heatmap_to_image(heatmap: np.ndarray) -> np.ndarray:
+  """(H, W) heatmap → uint8 grayscale image for metric writers."""
+  heatmap = np.asarray(heatmap, np.float32)
+  rng = heatmap.max() - heatmap.min()
+  if rng <= 0:
+    return np.zeros(heatmap.shape, np.uint8)
+  norm = (heatmap - heatmap.min()) / rng
+  return (norm * 255).astype(np.uint8)

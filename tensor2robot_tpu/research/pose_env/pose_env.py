@@ -78,6 +78,8 @@ class PoseEnv:
 
   def render(self) -> np.ndarray:
     """Rasterizes the table scene: uint8 (S, S, 3)."""
+    if self._target is None:
+      raise RuntimeError("Call reset() first.")
     s = self._image_size
     image = np.empty((s, s, 3), np.uint8)
     image[:] = TABLE_COLOR

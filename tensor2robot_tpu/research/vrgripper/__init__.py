@@ -1,0 +1,15 @@
+"""VRGripper: VR-teleop behavior cloning (SURVEY.md §2, BASELINE #5)."""
+
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_models import (
+    VRGripperRegressionModel,
+    VRGripperEnvModel,
+    vrgripper_maml_model,
+)
+from tensor2robot_tpu.research.vrgripper import episode_to_transitions
+
+__all__ = [
+    "VRGripperRegressionModel",
+    "VRGripperEnvModel",
+    "vrgripper_maml_model",
+    "episode_to_transitions",
+]

@@ -1,0 +1,165 @@
+"""VRGripper behavior-cloning models.
+
+Reference parity: research/vrgripper/vrgripper_env_models.py
+(SURVEY.md §2): FiLM-conditioned ResNet over camera images +
+proprioception; regression (MSE) or MDN action heads; meta-BC variants
+built on MAMLModel. BASELINE config #5.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.layers import mdn
+from tensor2robot_tpu.layers.resnet import ResNet
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, Metrics
+from tensor2robot_tpu.models.regression_model import RegressionModel
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+IMAGE_SIZE = 100  # the reference's VRGripper camera crops are ~100px
+ACTION_SIZE = 7   # cartesian twist (6) + gripper (1)
+GRIPPER_POSE_SIZE = 14
+
+
+class _VRGripperModule(nn.Module):
+  """FiLM ResNet conditioned on proprioception → action head."""
+
+  action_size: int = ACTION_SIZE
+  num_mixture_components: int = 0  # 0 → deterministic regression head
+  film: bool = True
+  compute_dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, features, mode: str):
+    train = mode == modes.TRAIN
+    proprio = features["gripper_pose"].astype(self.compute_dtype)
+    context = nn.relu(nn.Dense(32, dtype=self.compute_dtype,
+                               name="context_fc")(proprio))
+    tower = ResNet(depth=18, width=32, film=self.film,
+                   dtype=self.compute_dtype, name="tower")
+    image_features = tower(features["image"],
+                           context=context if self.film else None,
+                           train=train)
+    x = jnp.concatenate(
+        [image_features.astype(jnp.float32),
+         features["gripper_pose"].astype(jnp.float32)], axis=-1)
+    x = nn.relu(nn.Dense(128, dtype=jnp.float32, name="fc1")(x))
+
+    if self.num_mixture_components:
+      params = mdn.predict_mixture_params(
+          x, self.num_mixture_components, self.action_size, name="mdn")
+      return ts.TensorSpecStruct({
+          "mdn_log_alphas": params.log_alphas,
+          "mdn_mus": params.mus,
+          "mdn_log_sigmas": params.log_sigmas,
+          "inference_output": mdn.gaussian_mixture_approximate_mode(
+              params),
+      })
+    action = nn.Dense(self.action_size, dtype=jnp.float32,
+                      name="action")(x)
+    return ts.TensorSpecStruct({"inference_output": action})
+
+
+def _vrgripper_specs(image_size: int, gripper_pose_size: int,
+                     action_size: int):
+  features = ts.TensorSpecStruct({
+      "image": ts.ExtendedTensorSpec(
+          (image_size, image_size, 3), np.float32, name="image"),
+      "gripper_pose": ts.ExtendedTensorSpec(
+          (gripper_pose_size,), np.float32, name="gripper_pose"),
+  })
+  labels = ts.TensorSpecStruct({
+      "action": ts.ExtendedTensorSpec((action_size,), np.float32,
+                                      name="action"),
+  })
+  return features, labels
+
+
+@configurable
+class VRGripperRegressionModel(RegressionModel):
+  """Deterministic BC: (image, proprio) → action, MSE."""
+
+  def __init__(self, image_size: int = IMAGE_SIZE,
+               action_size: int = ACTION_SIZE,
+               gripper_pose_size: int = GRIPPER_POSE_SIZE,
+               film: bool = True, **kwargs):
+    super().__init__(label_key="action", **kwargs)
+    self._image_size = image_size
+    self._action_size = action_size
+    self._gripper_pose_size = gripper_pose_size
+    self._film = film
+
+  def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return _vrgripper_specs(self._image_size, self._gripper_pose_size,
+                            self._action_size)[0]
+
+  def get_label_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return _vrgripper_specs(self._image_size, self._gripper_pose_size,
+                            self._action_size)[1]
+
+  def build_module(self) -> nn.Module:
+    return _VRGripperModule(
+        action_size=self._action_size,
+        num_mixture_components=0,
+        film=self._film,
+        compute_dtype=self.compute_dtype)
+
+
+@configurable
+class VRGripperEnvModel(VRGripperRegressionModel):
+  """Multimodal BC: MDN action head, NLL loss; predict serves the
+  approximate mode (reference's mixture-head variant)."""
+
+  def __init__(self, num_mixture_components: int = 5, **kwargs):
+    super().__init__(**kwargs)
+    self._num_mixture_components = num_mixture_components
+
+  def build_module(self) -> nn.Module:
+    return _VRGripperModule(
+        action_size=self._action_size,
+        num_mixture_components=self._num_mixture_components,
+        film=self._film,
+        compute_dtype=self.compute_dtype)
+
+  def loss_fn(self, outputs, features, labels
+              ) -> Tuple[jnp.ndarray, Metrics]:
+    if labels is None:
+      raise ValueError("VRGripperEnvModel.loss_fn requires labels")
+    params = mdn.MixtureParams(
+        log_alphas=outputs["mdn_log_alphas"],
+        mus=outputs["mdn_mus"],
+        log_sigmas=outputs["mdn_log_sigmas"])
+    target = labels["action"].astype(jnp.float32)
+    nll = mdn.negative_log_likelihood(params, target)
+    mode_error = jnp.mean(jnp.linalg.norm(
+        outputs["inference_output"] - target, axis=-1))
+    return nll, {"nll": nll, "mode_action_error": mode_error}
+
+
+def vrgripper_maml_model(
+    num_inner_steps: int = 1,
+    inner_lr: float = 0.01,
+    num_condition_samples: int = 4,
+    num_inference_samples: int = 4,
+    **base_kwargs,
+):
+  """Meta-BC variant: MAML over the regression model (reference's
+  vrgripper meta/TEC family built on MAMLModel). float32 compute — MAML
+  inner-loop gradients are unstable in bfloat16 (see test_maml)."""
+  from tensor2robot_tpu.meta_learning import MAMLModel
+  base_kwargs.setdefault("compute_dtype", jnp.float32)
+  base = VRGripperRegressionModel(**base_kwargs)
+  return MAMLModel(
+      base,
+      num_inner_steps=num_inner_steps,
+      inner_lr=inner_lr,
+      num_condition_samples=num_condition_samples,
+      num_inference_samples=num_inference_samples)

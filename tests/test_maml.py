@@ -1,0 +1,151 @@
+"""Tests for MAML meta-learning: plumbing + actual fast adaptation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.meta_learning import (
+    MAMLModel,
+    meta_batch_from_arrays,
+    multi_batch_apply,
+)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+from tensor2robot_tpu.train.trainer import Trainer
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+from tensor2robot_tpu.utils.t2r_test_fixture import T2RModelFixture
+
+
+class TestMetaData:
+
+  def test_multi_batch_apply(self):
+    x = jnp.arange(24.0).reshape(2, 3, 4)
+    out = multi_batch_apply(lambda a: a * 2, 2, x)
+    assert out.shape == (2, 3, 4)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(24).reshape(
+        2, 3, 4) * 2)
+
+  def test_meta_batch_from_arrays(self):
+    features = ts.TensorSpecStruct(
+        {"x": np.arange(2 * 6 * 3).reshape(2, 6, 3).astype(np.float32)})
+    labels = ts.TensorSpecStruct(
+        {"target": np.arange(2 * 6 * 1).reshape(2, 6, 1).astype(
+            np.float32)})
+    meta = meta_batch_from_arrays(features, labels, 4, 2)
+    assert meta["condition/features/x"].shape == (2, 4, 3)
+    assert meta["inference/features/x"].shape == (2, 2, 3)
+    assert meta["condition/labels/target"].shape == (2, 4, 1)
+    # Without rng the split is deterministic head/tail.
+    np.testing.assert_array_equal(
+        meta["inference/features/x"][0], features["x"][0][4:6])
+    with pytest.raises(ValueError, match="pool"):
+      meta_batch_from_arrays(features, labels, 5, 2)
+
+
+class TestMAMLModel:
+
+  def _model(self, **kwargs):
+    kwargs.setdefault("optimizer_fn", lambda: optax.adam(1e-3))
+    inner = {k: kwargs.pop(k) for k in list(kwargs) if k in (
+        "num_inner_steps", "inner_lr", "learn_inner_lr", "first_order",
+        "num_condition_samples", "num_inference_samples")}
+    return MAMLModel(MockT2RModel(), **inner, **kwargs)
+
+  def test_spec_shapes(self):
+    model = self._model(num_condition_samples=5, num_inference_samples=3)
+    spec = model.get_feature_specification(modes.TRAIN)
+    assert spec["condition/features/x"].shape == (5, 3)
+    assert spec["inference/features/x"].shape == (3, 3)
+    assert spec["condition/labels/target"].shape == (5, 1)
+
+  def test_fixture_train(self):
+    T2RModelFixture().random_train(self._model(), max_train_steps=2)
+
+  def test_first_order_and_learned_lr_variants(self):
+    T2RModelFixture().random_train(
+        self._model(first_order=True), max_train_steps=2)
+    model = self._model(learn_inner_lr=True)
+    T2RModelFixture().random_train(model, max_train_steps=2)
+
+  def test_learned_lr_params_structure(self):
+    model = self._model(learn_inner_lr=True, inner_lr=0.05)
+    variables = model.init_variables(jax.random.key(0))
+    assert set(variables["params"].keys()) == {"base", "inner_lrs"}
+    lr_leaves = jax.tree_util.tree_leaves(variables["params"]["inner_lrs"])
+    assert all(float(l) == pytest.approx(0.05) for l in lr_leaves)
+
+  def test_second_order_differs_from_first_order(self):
+    """The MAML gradient must differ when inner-loop grads carry
+    second-order terms."""
+    def grad_for(first_order):
+      model = self._model(first_order=first_order, inner_lr=0.1)
+      variables = model.init_variables(jax.random.key(0))
+      spec = model.get_feature_specification(modes.TRAIN)
+      features = ts.make_random_batch(
+          spec, batch_size=4, rng=np.random.default_rng(0))
+      features = jax.tree_util.tree_map(jnp.asarray, features)
+
+      def loss(params):
+        v = {**variables, "params": params}
+        l, _ = model.model_train_fn(
+            v, features, None, rngs={"dropout": jax.random.key(1)})
+        return l
+
+      return jax.grad(loss)(variables["params"])
+
+    g1 = grad_for(True)
+    g2 = grad_for(False)
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(np.max(np.abs(np.asarray(a) - np.asarray(b)))),
+        g1, g2)
+    assert max(jax.tree_util.tree_leaves(diffs)) > 1e-7
+
+  def test_adaptation_beats_no_adaptation(self):
+    """Meta-train on linear tasks y = w_t x; adapted predictions on
+    fresh tasks must beat the unadapted meta-init.
+
+    float32 compute: bfloat16 inner-loop gradients are too noisy for
+    MAML to meta-learn (empirically ratio ~1.7 in bf16 vs ~0.25 in f32)
+    — models wrapped by MAMLModel should use float32 compute_dtype.
+    """
+    def make_meta_batch(num_tasks, seed):
+      task_rng = np.random.default_rng(seed)
+      ws = task_rng.uniform(-2, 2, size=(num_tasks, 3, 1))
+      xs = task_rng.standard_normal((num_tasks, 16, 3)).astype(np.float32)
+      ys = np.einsum("tnd,tdo->tno", xs, ws).astype(np.float32)
+      return meta_batch_from_arrays(
+          ts.TensorSpecStruct({"x": xs}),
+          ts.TensorSpecStruct({"target": ys}),
+          num_condition_samples=8, num_inference_samples=8)
+
+    def build(num_inner_steps):
+      return MAMLModel(
+          MockT2RModel(compute_dtype=jnp.float32),
+          num_inner_steps=num_inner_steps, inner_lr=0.05,
+          num_condition_samples=8, num_inference_samples=8,
+          optimizer_fn=lambda: optax.adam(3e-3))
+
+    model = build(num_inner_steps=3)
+    trainer = Trainer(model, seed=0)
+    state = trainer.create_train_state()
+    for step in range(600):
+      batch = make_meta_batch(8, seed=step)
+      features = trainer.shard_batch(
+          jax.tree_util.tree_map(jnp.asarray, batch))
+      state, metrics = trainer.train_step(state, features, None)
+      _ = float(metrics["loss"])
+
+    # Fresh tasks: query loss WITH adaptation must beat the same
+    # meta-parameters evaluated with zero inner steps.
+    test_batch = make_meta_batch(16, seed=10_000)
+    features = jax.tree_util.tree_map(jnp.asarray, test_batch)
+    variables = jax.device_get(state.variables())
+
+    def query_loss(m):
+      return float(m.model_eval_fn(variables, features, None)["outer_loss"])
+
+    adapted = query_loss(model)
+    unadapted = query_loss(build(num_inner_steps=0))
+    assert adapted < unadapted * 0.5, (adapted, unadapted)

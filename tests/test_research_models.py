@@ -1,0 +1,119 @@
+"""Tests for grasp2vec and vrgripper model families."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.research.grasp2vec import losses, visualization
+from tensor2robot_tpu.research.grasp2vec.grasp2vec_model import (
+    Grasp2VecModel,
+)
+from tensor2robot_tpu.research.vrgripper import episode_to_transitions
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_models import (
+    VRGripperEnvModel,
+    VRGripperRegressionModel,
+    vrgripper_maml_model,
+)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+from tensor2robot_tpu.utils.t2r_test_fixture import T2RModelFixture
+
+
+class TestGrasp2Vec:
+
+  def test_npairs_loss_prefers_matching_pairs(self):
+    rng = np.random.default_rng(0)
+    matched = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    loss_match, acc_match = losses.npairs_loss(matched, matched,
+                                               l2_reg=0.0)
+    shuffled = jnp.asarray(np.roll(np.asarray(matched), 1, axis=0))
+    loss_mismatch, _ = losses.npairs_loss(matched, shuffled, l2_reg=0.0)
+    assert float(loss_match) < float(loss_mismatch)
+    assert float(acc_match) == 1.0
+
+  def test_fixture_train(self):
+    model = Grasp2VecModel(
+        image_size=32, depth=18, embedding_size=32,
+        optimizer_fn=lambda: optax.adam(1e-3))
+    result = T2RModelFixture().random_train(model, max_train_steps=2)
+    assert "retrieval_accuracy" in result.train_metrics
+
+  def test_embedding_arithmetic_outputs(self):
+    model = Grasp2VecModel(image_size=32, depth=18, embedding_size=16)
+    variables = model.init_variables(jax.random.key(0), batch_size=2)
+    spec = model.get_feature_specification(modes.PREDICT)
+    features = ts.make_random_batch(spec, batch_size=2)
+    features = jax.tree_util.tree_map(jnp.asarray, features)
+    outputs, _ = model.inference_network_fn(
+        variables, features, modes.PREDICT)
+    np.testing.assert_allclose(
+        np.asarray(outputs["inference_output"]),
+        np.asarray(outputs["pre_embedding"])
+        - np.asarray(outputs["post_embedding"]), atol=1e-5)
+    assert outputs["scene_spatial"].ndim == 4
+
+  def test_heatmap(self):
+    scene = jnp.asarray(
+        np.random.default_rng(0).standard_normal((2, 4, 5, 16)),
+        jnp.float32)
+    query = jnp.asarray(
+        np.random.default_rng(1).standard_normal((2, 16)), jnp.float32)
+    heatmap = visualization.embedding_heatmap(scene, query)
+    assert heatmap.shape == (2, 4, 5)
+    np.testing.assert_allclose(
+        np.asarray(heatmap).reshape(2, -1).sum(-1), 1.0, atol=1e-5)
+    image = visualization.heatmap_to_image(np.asarray(heatmap[0]))
+    assert image.dtype == np.uint8
+
+
+class TestVRGripper:
+
+  def test_regression_fixture_train(self):
+    model = VRGripperRegressionModel(
+        image_size=32, optimizer_fn=lambda: optax.adam(1e-3))
+    T2RModelFixture().random_train(model, max_train_steps=2)
+
+  def test_mdn_fixture_train(self):
+    model = VRGripperEnvModel(
+        image_size=32, num_mixture_components=3,
+        optimizer_fn=lambda: optax.adam(1e-3))
+    result = T2RModelFixture().random_train(model, max_train_steps=2)
+    assert "nll" in result.train_metrics
+
+  def test_film_off_variant(self):
+    model = VRGripperRegressionModel(image_size=32, film=False)
+    T2RModelFixture().random_train(model, max_train_steps=1)
+
+  def test_maml_variant_trains(self):
+    model = vrgripper_maml_model(
+        image_size=32, num_condition_samples=2, num_inference_samples=2)
+    T2RModelFixture().random_train(model, max_train_steps=1, batch_size=8)
+
+  def test_mdn_predict_is_mode(self):
+    model = VRGripperEnvModel(image_size=32, num_mixture_components=3)
+    variables = model.init_variables(jax.random.key(0))
+    spec = model.get_feature_specification(modes.PREDICT)
+    features = jax.tree_util.tree_map(
+        jnp.asarray, ts.make_random_batch(spec, batch_size=2))
+    outputs = model.predict_fn(variables, features)
+    assert outputs["inference_output"].shape == (2, 7)
+
+  def test_episode_to_transitions(self, tmp_path):
+    episode = {
+        "images": np.zeros((5, 32, 32, 3), np.uint8),
+        "gripper_poses": np.zeros((5, 14), np.float32),
+        "actions": np.zeros((5, 7), np.float32),
+    }
+    path = str(tmp_path / "episodes.tfrecord")
+    episode_to_transitions.write_episodes(path, [episode, episode])
+    from tensor2robot_tpu.data import tfrecord
+    records = list(tfrecord.read_tfrecords(path))
+    assert len(records) == 10
+    from tensor2robot_tpu.data import example_proto
+    decoded = example_proto.decode_example(records[0])
+    assert set(decoded) == {"image", "gripper_pose", "action"}
+    with pytest.raises(ValueError, match="disagree"):
+      bad = dict(episode, actions=episode["actions"][:3])
+      list(episode_to_transitions.episode_to_examples(bad))
