@@ -1,0 +1,178 @@
+// Native data-path kernels: CRC32C, TFRecord framing, JPEG decode.
+//
+// Reference parity: the reference's input pipeline got TFRecord framing,
+// example parsing, and image decode from TensorFlow's C++ kernels
+// (SURVEY.md §2 native-components table). This is the rebuild's native
+// equivalent for the host-side hot loops, exposed as extern "C" and
+// loaded from Python via ctypes (no pybind11 in the image).
+//
+// Build: python -m tensor2robot_tpu.data.build_native
+//   (g++ -O3 -shared -fPIC native_data.cc -o libt2rnative.so -ljpeg)
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#include <csetjmp>
+#include <cstdio>
+
+extern "C" {
+#include <jpeglib.h>
+}
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli), table-driven, with TFRecord's masking.
+// ---------------------------------------------------------------------------
+
+// Table built at load time: ctypes calls drop the GIL, so lazy init
+// with a plain flag would be a data race across loader threads.
+struct CrcTable {
+  uint32_t t[256];
+  CrcTable() {
+    const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; ++k) {
+        crc = (crc & 1) ? (crc >> 1) ^ poly : crc >> 1;
+      }
+      t[i] = crc;
+    }
+  }
+};
+const CrcTable g_crc{};
+
+uint32_t crc32c(const uint8_t* data, size_t len) {
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; ++i) {
+    crc = g_crc.t[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  }
+  return crc ^ 0xFFFFFFFFu;
+}
+
+uint32_t masked_crc32c(const uint8_t* data, size_t len) {
+  uint32_t crc = crc32c(data, len);
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+uint32_t read_u32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;  // little-endian hosts only (x86/ARM)
+}
+
+uint64_t read_u64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t t2r_masked_crc32c(const uint8_t* data, uint64_t len) {
+  return masked_crc32c(data, static_cast<size_t>(len));
+}
+
+// Indexes a whole TFRecord file buffer. Writes up to max_records
+// (offset, length) pairs describing each record's payload. Returns the
+// number of records found, or a negative error:
+//   -1 truncated header/payload, -2 length-CRC mismatch,
+//   -3 data-CRC mismatch, -4 more than max_records records.
+int64_t t2r_tfrecord_index(const uint8_t* buf, uint64_t buf_len,
+                           uint64_t* offsets, uint64_t* lengths,
+                           uint64_t max_records, int32_t verify_crc) {
+  uint64_t pos = 0;
+  int64_t n = 0;
+  while (pos < buf_len) {
+    if (pos + 12 > buf_len) return -1;
+    uint64_t rec_len = read_u64(buf + pos);
+    if (verify_crc) {
+      if (read_u32(buf + pos + 8) != masked_crc32c(buf + pos, 8)) return -2;
+    }
+    uint64_t data_start = pos + 12;
+    // No-overflow bounds check: a corrupt length field must not wrap.
+    uint64_t remaining = buf_len - data_start;
+    if (remaining < 4 || rec_len > remaining - 4) return -1;
+    if (verify_crc) {
+      if (read_u32(buf + data_start + rec_len) !=
+          masked_crc32c(buf + data_start, rec_len)) return -3;
+    }
+    if (static_cast<uint64_t>(n) >= max_records) return -4;
+    offsets[n] = data_start;
+    lengths[n] = rec_len;
+    ++n;
+    pos = data_start + rec_len + 4;
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// JPEG decode via libjpeg.
+// ---------------------------------------------------------------------------
+
+struct T2rJpegError {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+void t2r_jpeg_error_exit(j_common_ptr cinfo) {
+  T2rJpegError* err = reinterpret_cast<T2rJpegError*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+// Reads image dimensions: returns 0 on success.
+int32_t t2r_jpeg_info(const uint8_t* data, uint64_t len,
+                      int32_t* width, int32_t* height,
+                      int32_t* channels) {
+  jpeg_decompress_struct cinfo;
+  T2rJpegError jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = t2r_jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  *width = cinfo.image_width;
+  *height = cinfo.image_height;
+  *channels = cinfo.num_components;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decodes into caller-allocated out (H*W*channels bytes). channels
+// must be 1 or 3; libjpeg converts colorspace. Returns 0 on success.
+int32_t t2r_jpeg_decode(const uint8_t* data, uint64_t len,
+                        uint8_t* out, int32_t channels) {
+  jpeg_decompress_struct cinfo;
+  T2rJpegError jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = t2r_jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = (channels == 1) ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const size_t row_stride =
+      static_cast<size_t>(cinfo.output_width) * cinfo.output_components;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out + row_stride * cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,40 @@
+"""Builds the native data-path library (g++, links libjpeg).
+
+Usage: python -m tensor2robot_tpu.data.build_native
+The library is optional: every consumer falls back to the pure-Python
+implementations when it is absent or fails to build.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+SOURCE = os.path.join(_THIS_DIR, "_native", "native_data.cc")
+LIBRARY = os.path.join(_THIS_DIR, "_native", "libt2rnative.so")
+
+
+def build(verbose: bool = True) -> str:
+  """Compiles the shared library; returns its path."""
+  cmd = [
+      "g++", "-O3", "-march=native", "-shared", "-fPIC",
+      SOURCE, "-o", LIBRARY, "-ljpeg",
+  ]
+  result = subprocess.run(cmd, capture_output=True, text=True)
+  if result.returncode != 0:
+    raise RuntimeError(
+        f"native build failed:\n{result.stderr[-2000:]}")
+  if verbose:
+    print(f"Built {LIBRARY}")
+  return LIBRARY
+
+
+def main() -> int:
+  build()
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -1,0 +1,117 @@
+"""ctypes loader for the native data-path library, with auto-build.
+
+Consumers call `get_native()`; None means "use the pure-Python path"
+(missing compiler, missing libjpeg, or build failure — all non-fatal).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_log = logging.getLogger(__name__)
+_lock = threading.Lock()
+_native: Optional["NativeData"] = None
+_load_attempted = False
+
+# Set T2R_DISABLE_NATIVE=1 to force the pure-Python data path.
+_DISABLE_ENV = "T2R_DISABLE_NATIVE"
+
+
+class NativeData:
+  """Typed wrappers over libt2rnative.so."""
+
+  def __init__(self, lib: ctypes.CDLL):
+    self._lib = lib
+    lib.t2r_masked_crc32c.restype = ctypes.c_uint32
+    lib.t2r_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.t2r_tfrecord_index.restype = ctypes.c_int64
+    lib.t2r_tfrecord_index.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64, ctypes.c_int32]
+    lib.t2r_jpeg_info.restype = ctypes.c_int32
+    lib.t2r_jpeg_info.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.t2r_jpeg_decode.restype = ctypes.c_int32
+    lib.t2r_jpeg_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+
+  def masked_crc32c(self, data: bytes) -> int:
+    return self._lib.t2r_masked_crc32c(data, len(data))
+
+  def tfrecord_index(self, buf: bytes, verify_crc: bool = True
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (offsets, lengths) of record payloads in `buf`.
+
+    Whole-buffer indexing: memory is O(len(buf)). For large shards
+    prefer the streaming tfrecord.read_tfrecords (which uses the native
+    CRC but O(record) memory)."""
+    # Worst-case record size 16 bytes (empty payload) → bound the index.
+    max_records = max(len(buf) // 16, 1)
+    offsets = (ctypes.c_uint64 * max_records)()
+    lengths = (ctypes.c_uint64 * max_records)()
+    n = self._lib.t2r_tfrecord_index(
+        buf, len(buf), offsets, lengths, max_records, int(verify_crc))
+    if n < 0:
+      reasons = {-1: "truncated record", -2: "length CRC mismatch",
+                 -3: "data CRC mismatch", -4: "index overflow"}
+      raise ValueError(
+          f"Corrupt TFRecord buffer: {reasons.get(n, n)}")
+    # as_array derives shape from the ctypes array type (max_records);
+    # slice down to the actual record count.
+    return (np.ctypeslib.as_array(offsets)[:n].copy(),
+            np.ctypeslib.as_array(lengths)[:n].copy())
+
+  def jpeg_decode(self, data: bytes,
+                  channels: Optional[int] = None) -> np.ndarray:
+    """Decodes a JPEG to (H, W, C) uint8 (C = 1 or 3)."""
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
+    c = ctypes.c_int32()
+    if self._lib.t2r_jpeg_info(data, len(data),
+                               ctypes.byref(w), ctypes.byref(h),
+                               ctypes.byref(c)) != 0:
+      raise ValueError("Invalid JPEG data")
+    out_channels = channels or (1 if c.value == 1 else 3)
+    if out_channels not in (1, 3):
+      raise ValueError(f"channels must be 1 or 3, got {out_channels}")
+    out = np.empty((h.value, w.value, out_channels), np.uint8)
+    rc = self._lib.t2r_jpeg_decode(
+        data, len(data),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), out_channels)
+    if rc != 0:
+      raise ValueError("JPEG decode failed")
+    return out
+
+
+def get_native(auto_build: bool = True) -> Optional[NativeData]:
+  """The loaded native library, building it on first use; None if
+  unavailable."""
+  global _native, _load_attempted
+  with _lock:
+    if _native is not None or _load_attempted:
+      return _native
+    _load_attempted = True
+    if os.environ.get(_DISABLE_ENV) == "1":
+      return None
+    from tensor2robot_tpu.data import build_native
+    try:
+      stale = (os.path.exists(build_native.LIBRARY)
+               and os.path.getmtime(build_native.SOURCE)
+               > os.path.getmtime(build_native.LIBRARY))
+      if (not os.path.exists(build_native.LIBRARY) or stale) and auto_build:
+        build_native.build(verbose=False)
+      _native = NativeData(ctypes.CDLL(build_native.LIBRARY))
+    except Exception as e:  # missing toolchain/libjpeg → Python path
+      _log.info("Native data path unavailable (%s); using pure Python.", e)
+      _native = None
+    return _native

@@ -20,7 +20,20 @@ from tensor2robot_tpu.specs import tensorspec_utils as ts
 
 
 def decode_image(data: bytes, data_format: Optional[str] = None) -> np.ndarray:
-  """Decodes an encoded image (jpeg/png) to an HWC uint8 array via PIL."""
+  """Decodes an encoded image to an HWC uint8 array.
+
+  JPEGs go through the native libjpeg kernel when available (the input
+  pipeline's hot loop — SURVEY.md §3.1); PIL handles everything else and
+  serves as the fallback.
+  """
+  if data_format is None or data_format == "jpeg":
+    from tensor2robot_tpu.data import native
+    lib = native.get_native()
+    if lib is not None and data[:2] == b"\xff\xd8":  # JPEG SOI marker
+      try:
+        return lib.jpeg_decode(data)
+      except ValueError:
+        pass  # e.g. CMYK: libjpeg can't convert — PIL below can
   from PIL import Image  # host-side decode only; never on device
 
   with Image.open(io.BytesIO(data)) as img:

@@ -93,8 +93,14 @@ def read_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
   """Yields records from one TFRecord file.
 
   CRC verification is on by default (corrupt robot-fleet data should fail
-  loudly, not train silently); the C++ reader keeps the same default.
+  loudly, not train silently). Uses the C++ framing/CRC kernel when the
+  native library is available; pure Python otherwise.
   """
+  # Streaming framing (O(record) memory even on multi-GB fleet shards)
+  # with the CRC — the per-byte hot loop — done natively when available.
+  from tensor2robot_tpu.data import native
+  lib = native.get_native()
+  crc = lib.masked_crc32c if lib is not None else masked_crc32c
   with open(path, "rb") as f:
     while True:
       header = f.read(12)
@@ -103,7 +109,7 @@ def read_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
       if len(header) < 12:
         raise ValueError(f"{path}: truncated record header")
       length, length_crc = struct.unpack("<QI", header)
-      if verify_crc and masked_crc32c(header[:8]) != length_crc:
+      if verify_crc and crc(header[:8]) != length_crc:
         raise ValueError(f"{path}: corrupted record length (CRC mismatch)")
       data = f.read(length)
       if len(data) < length:
@@ -112,7 +118,7 @@ def read_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
       if len(footer) < 4:
         raise ValueError(f"{path}: truncated record footer")
       (data_crc,) = struct.unpack("<I", footer)
-      if verify_crc and masked_crc32c(data) != data_crc:
+      if verify_crc and crc(data) != data_crc:
         raise ValueError(f"{path}: corrupted record data (CRC mismatch)")
       yield data
 
