@@ -77,11 +77,20 @@ class TCBlock(nn.Module):
 
 
 class AttentionBlock(nn.Module):
-  """Single-head causal attention; output concatenated to input."""
+  """Single-head causal attention; output concatenated to input.
+
+  `seq_mesh` switches the attention core to sequence-parallel ring
+  attention over that mesh's `seq_axis` — episodes longer than one
+  device's memory shard across the ring (parallel/ring_attention.py);
+  the dense core stays the default for the short episodes robot tasks
+  actually have (SURVEY.md §5.7).
+  """
 
   key_size: int
   value_size: int
   dtype: Any = jnp.bfloat16
+  seq_mesh: Any = None
+  seq_axis: str = "seq"
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -92,6 +101,13 @@ class AttentionBlock(nn.Module):
         x.astype(self.dtype))
     values = nn.Dense(self.value_size, dtype=self.dtype, name="value")(
         x.astype(self.dtype))
+    if self.seq_mesh is not None:
+      from tensor2robot_tpu.parallel.ring_attention import ring_attention
+      read = ring_attention(
+          queries[:, :, None, :], keys[:, :, None, :],
+          values[:, :, None, :],
+          mesh=self.seq_mesh, axis=self.seq_axis, causal=True)[:, :, 0, :]
+      return jnp.concatenate([x.astype(self.dtype), read], axis=-1)
     # float32 logits/softmax: attention normalization is precision-
     # sensitive even at short T.
     logits = jnp.einsum("btk,bsk->bts", queries, keys).astype(jnp.float32)

@@ -14,6 +14,15 @@ from tensor2robot_tpu.parallel.mesh import (
     shard_batch,
     local_batch_slice,
 )
+from tensor2robot_tpu.parallel.ring_attention import (
+    dense_attention_reference,
+    ring_attention,
+)
+from tensor2robot_tpu.parallel.tp_rules import (
+    infer_dense_tp_specs,
+    infer_dense_tp_specs_from_model,
+    specs_to_shardings,
+)
 
 __all__ = [
     "create_mesh",
@@ -21,4 +30,9 @@ __all__ = [
     "replicated_sharding",
     "shard_batch",
     "local_batch_slice",
+    "ring_attention",
+    "dense_attention_reference",
+    "infer_dense_tp_specs",
+    "infer_dense_tp_specs_from_model",
+    "specs_to_shardings",
 ]

@@ -1,0 +1,62 @@
+"""Tensor-parallel sharding rules for model parameters.
+
+The mesh abstraction (SURVEY.md §5.8) reserves extra axes for model
+parallelism; these helpers derive `PartitionSpec`s for parameter trees so
+the Trainer can lay large matmul weights across a `model` axis — XLA
+then inserts the all-gathers/reduce-scatters over ICI. Parity note: the
+reference had no TP at all; this is capability beyond it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def infer_dense_tp_specs(
+    params: Any,
+    mesh: Mesh,
+    axis: str = "model",
+    min_width: int = 64,
+) -> Any:
+  """PartitionSpec tree: shard wide matmul kernels' output dim over `axis`.
+
+  Heuristic column parallelism: any parameter with ndim ≥ 2 whose last
+  dimension is ≥ min_width and divisible by the axis size gets
+  P(..., axis); everything else (biases, norm scales, small heads) is
+  replicated. Returns all-replicated specs when the mesh lacks `axis`
+  or it has size 1, so callers can apply unconditionally.
+  """
+  axis_size = mesh.shape.get(axis, 1)
+
+  def rule(leaf):
+    shape = np.shape(leaf)
+    if (axis_size > 1 and len(shape) >= 2
+        and shape[-1] >= min_width and shape[-1] % axis_size == 0):
+      return PartitionSpec(*([None] * (len(shape) - 1)), axis)
+    return PartitionSpec()
+
+  return jax.tree_util.tree_map(rule, params)
+
+
+def infer_dense_tp_specs_from_model(
+    model,
+    mesh: Mesh,
+    axis: str = "model",
+    min_width: int = 64,
+) -> Any:
+  """Derives TP specs from a T2R model without materializing weights."""
+  shapes = jax.eval_shape(
+      lambda rng: model.init_variables(rng), jax.random.key(0))
+  return infer_dense_tp_specs(shapes["params"], mesh, axis=axis,
+                              min_width=min_width)
+
+
+def specs_to_shardings(specs: Any, mesh: Mesh) -> Any:
+  """PartitionSpec tree → NamedSharding tree."""
+  return jax.tree_util.tree_map(
+      lambda spec: NamedSharding(mesh, spec), specs,
+      is_leaf=lambda x: isinstance(x, PartitionSpec))

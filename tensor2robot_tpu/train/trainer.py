@@ -30,6 +30,7 @@ import optax
 
 from tensor2robot_tpu import modes
 from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.parallel import tp_rules
 from tensor2robot_tpu.train.train_state import TrainState
 
 
@@ -42,16 +43,32 @@ class Trainer:
       mesh: Optional[jax.sharding.Mesh] = None,
       seed: int = 0,
       data_axis: str = "data",
+      param_specs=None,
   ):
+    """Args:
+      param_specs: optional PartitionSpec pytree (or prefix) for params —
+        tensor parallelism over extra mesh axes (see
+        parallel.tp_rules.infer_dense_tp_specs). None = replicated
+        params, pure DP (the reference's only strategy).
+    """
     self.model = model
     self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
     self.data_axis = data_axis
+    self.param_specs = param_specs
     self._base_rng = jax.random.key(seed)
     self._optimizer = model.create_optimizer()
     self._batch_sharding = mesh_lib.batch_sharding(self.mesh, data_axis)
     self._replicated = mesh_lib.replicated_sharding(self.mesh)
     self._train_step = None
     self._eval_step = None
+
+  def _constrain_params(self, params):
+    """Pins params to their TP shardings inside jit; opt-state shardings
+    propagate from these constraints automatically."""
+    if self.param_specs is None:
+      return params
+    return jax.lax.with_sharding_constraint(
+        params, tp_rules.specs_to_shardings(self.param_specs, self.mesh))
 
   # --- state ---------------------------------------------------------------
 
@@ -60,7 +77,7 @@ class Trainer:
     def _init(rng: jax.Array) -> TrainState:
       variables = self.model.init_variables(rng, batch_size=batch_size)
       variables = dict(variables)
-      params = variables.pop("params")
+      params = self._constrain_params(variables.pop("params"))
       ema = (jax.tree_util.tree_map(jnp.copy, params)
              if self.model.use_avg_model_params else None)
       return TrainState(
@@ -70,7 +87,11 @@ class Trainer:
           opt_state=self._optimizer.init(params),
           ema_params=ema)
 
-    init = jax.jit(_init, out_shardings=self._replicated)
+    if self.param_specs is None:
+      init = jax.jit(_init, out_shardings=self._replicated)
+    else:
+      # TP: params pinned by constraints inside; opt/ema follow.
+      init = jax.jit(_init)
     state = init(self._base_rng)
     if self.model.init_from_checkpoint:
       state = self._warm_start(state, self.model.init_from_checkpoint)
@@ -81,7 +102,11 @@ class Trainer:
     from tensor2robot_tpu.train import checkpoints
     restored = checkpoints.restore_params(checkpoint_path)
     params = checkpoints.merge_params(state.params, restored)
-    params = jax.device_put(params, self._replicated)
+    if self.param_specs is None:
+      params = jax.device_put(params, self._replicated)
+    else:
+      params = jax.device_put(
+          params, tp_rules.specs_to_shardings(self.param_specs, self.mesh))
     return state.replace(params=params)
 
   # --- steps ---------------------------------------------------------------
@@ -105,7 +130,8 @@ class Trainer:
       (_, (metrics, new_model_state)), grads = grad_fn(state.params)
       updates, new_opt_state = optimizer.update(
           grads, state.opt_state, state.params)
-      new_params = optax.apply_updates(state.params, updates)
+      new_params = self._constrain_params(
+          optax.apply_updates(state.params, updates))
       new_ema = state.ema_params
       if new_ema is not None:
         new_ema = optax.incremental_update(
@@ -119,12 +145,16 @@ class Trainer:
           ema_params=new_ema)
       return new_state, metrics
 
-    return jax.jit(
-        step_fn,
-        in_shardings=(self._replicated, self._batch_sharding,
-                      self._batch_sharding),
-        out_shardings=(self._replicated, self._replicated),
-        donate_argnums=(0,))
+    if self.param_specs is None:
+      return jax.jit(
+          step_fn,
+          in_shardings=(self._replicated, self._batch_sharding,
+                        self._batch_sharding),
+          out_shardings=(self._replicated, self._replicated),
+          donate_argnums=(0,))
+    # TP: shardings inferred from the (already correctly placed) inputs
+    # plus the in-step constraints.
+    return jax.jit(step_fn, donate_argnums=(0,))
 
   def _build_eval_step(self):
     model = self.model
@@ -134,11 +164,13 @@ class Trainer:
       variables = state.variables(use_ema=True)
       return model.model_eval_fn(variables, features, labels)
 
-    return jax.jit(
-        step_fn,
-        in_shardings=(self._replicated, self._batch_sharding,
-                      self._batch_sharding),
-        out_shardings=self._replicated)
+    if self.param_specs is None:
+      return jax.jit(
+          step_fn,
+          in_shardings=(self._replicated, self._batch_sharding,
+                        self._batch_sharding),
+          out_shardings=self._replicated)
+    return jax.jit(step_fn)
 
   # --- public API ----------------------------------------------------------
 

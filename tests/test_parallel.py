@@ -1,0 +1,154 @@
+"""Tests for ring attention and tensor-parallel sharding (8-dev CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.data.default_input_generator import (
+    DefaultRandomInputGenerator,
+)
+from tensor2robot_tpu.parallel import (
+    create_mesh,
+    dense_attention_reference,
+    infer_dense_tp_specs,
+    infer_dense_tp_specs_from_model,
+    ring_attention,
+)
+from tensor2robot_tpu.train.trainer import Trainer
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+
+def _qkv(b=2, t=32, h=4, d=16, dtype=jnp.float32, seed=0):
+  rng = np.random.default_rng(seed)
+  mk = lambda: jnp.asarray(
+      rng.standard_normal((b, t, h, d)).astype(np.float32), dtype)
+  return mk(), mk(), mk()
+
+
+class TestRingAttention:
+
+  @pytest.mark.parametrize("causal", [False, True])
+  def test_matches_dense_reference(self, causal):
+    mesh = create_mesh({"seq": -1})
+    q, k, v = _qkv()
+    out = ring_attention(q, k, v, mesh, axis="seq", causal=causal)
+    expected = dense_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5)
+
+  def test_bfloat16(self):
+    mesh = create_mesh({"seq": -1})
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    out = ring_attention(q, k, v, mesh, causal=True)
+    assert out.dtype == jnp.bfloat16
+    expected = dense_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(expected, np.float32),
+        atol=0.05)
+
+  def test_two_axis_mesh(self):
+    """Ring over 'seq' composes with a data axis on the same mesh; the
+    batch is sharded over 'data' so rows don't duplicate work."""
+    mesh = create_mesh({"data": 2, "seq": 4})
+    q, k, v = _qkv(t=16)
+    out = ring_attention(q, k, v, mesh, axis="seq", batch_axis="data")
+    expected = dense_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5)
+
+  def test_gradients_flow(self):
+    mesh = create_mesh({"seq": -1})
+    q, k, v = _qkv(t=16)
+
+    def loss_ring(q, k, v):
+      return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+      return jnp.sum(
+          dense_attention_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+class TestSequenceParallelSnail:
+
+  def test_snail_attention_ring_matches_dense(self):
+    from tensor2robot_tpu.layers import snail
+    mesh = create_mesh({"seq": -1})
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((2, 16, 8)), jnp.float32)
+    dense = snail.AttentionBlock(key_size=8, value_size=8,
+                                 dtype=jnp.float32)
+    ring = snail.AttentionBlock(key_size=8, value_size=8,
+                                dtype=jnp.float32, seq_mesh=mesh)
+    variables = dense.init(jax.random.key(0), x)
+    out_dense = dense.apply(variables, x)
+    out_ring = ring.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out_ring),
+                               np.asarray(out_dense), atol=2e-5)
+
+
+class TestTensorParallel:
+
+  def test_spec_inference(self):
+    mesh = create_mesh({"data": 4, "model": 2})
+    params = {
+        "dense": {"kernel": np.zeros((32, 128)), "bias": np.zeros((128,))},
+        "head": {"kernel": np.zeros((128, 3))},
+        "norm": {"scale": np.zeros((128,))},
+    }
+    specs = infer_dense_tp_specs(params, mesh)
+    assert specs["dense"]["kernel"] == PartitionSpec(None, "model")
+    assert specs["dense"]["bias"] == PartitionSpec()     # 1-D
+    assert specs["head"]["kernel"] == PartitionSpec()    # too narrow
+    assert specs["norm"]["scale"] == PartitionSpec()
+
+  def test_no_model_axis_means_replicated(self):
+    mesh = create_mesh()  # data only
+    specs = infer_dense_tp_specs(
+        {"k": np.zeros((32, 128))}, mesh)
+    assert specs["k"] == PartitionSpec()
+
+  def test_tp_training_matches_dp(self):
+    """DP+TP over a 4x2 mesh computes the same optimization trajectory
+    as pure DP (up to float noise) — the collectives are correct."""
+    def run(param_specs, mesh):
+      model = MockT2RModel(hidden_size=128,
+                          optimizer_fn=lambda: optax.adam(1e-2))
+      trainer = Trainer(model, mesh=mesh, seed=5,
+                        param_specs=param_specs)
+      state = trainer.create_train_state()
+      gen = DefaultRandomInputGenerator(batch_size=8, seed=0)
+      gen.set_specification_from_model(model, modes.TRAIN)
+      features, labels = next(gen.create_dataset_fn(modes.TRAIN)())
+      features, labels = trainer.shard_batch((features, labels))
+      losses = []
+      for _ in range(5):
+        state, metrics = trainer.train_step(state, features, labels)
+        losses.append(float(metrics["loss"]))
+      return losses, state
+
+    dp_mesh = create_mesh()
+    dp_losses, _ = run(None, dp_mesh)
+
+    tp_mesh = create_mesh({"data": 4, "model": 2})
+    model = MockT2RModel(hidden_size=128)
+    specs = infer_dense_tp_specs_from_model(model, tp_mesh)
+    # The wide hidden layer must actually be sharded for this test to
+    # mean anything.
+    flat = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: isinstance(s, PartitionSpec))
+    assert any(s != PartitionSpec() for s in flat)
+    tp_losses, tp_state = run(specs, tp_mesh)
+
+    np.testing.assert_allclose(tp_losses, dp_losses, rtol=1e-4)
+    # Params really live sharded on the model axis.
+    dense_kernel = tp_state.params["Dense_0"]["kernel"]
+    assert "model" in tuple(dense_kernel.sharding.spec)
