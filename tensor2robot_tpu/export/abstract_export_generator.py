@@ -50,11 +50,13 @@ class AbstractExportGenerator(abc.ABC):
     return self._feature_spec
 
   @abc.abstractmethod
-  def export(self, variables: Any) -> str:
+  def export(self, variables: Any, global_step: int = 0) -> str:
     """Writes one new version under export_root; returns its final dir.
 
     Args:
       variables: the flax variables dict ({"params": ..., batch_stats...})
         to serve — callers pass EMA params when use_avg_model_params
         (TrainState.variables(use_ema=True)).
+      global_step: the train step the variables were snapshotted at,
+        recorded in the spec assets (0 = unknown).
     """

@@ -17,6 +17,7 @@ from typing import List, Optional, Tuple
 from tensor2robot_tpu.specs import tensorspec_utils as ts
 
 SPEC_ASSET_NAME = "t2r_assets.json"
+SPEC_ASSET_PB_NAME = "t2r_assets.pb"
 
 
 def normalize_serving_outputs(outputs) -> dict:
@@ -98,9 +99,10 @@ def resolve_export_root(generator, model_dir: Optional[str]) -> None:
     generator.export_root = os.path.join(model_dir, "export", "latest")
 
 
-def export_and_gc(generator, variables, keep: int) -> str:
+def export_and_gc(generator, variables, keep: int,
+                  global_step: int = 0) -> str:
   """One export + version GC (the publish step both export paths share)."""
-  export_dir = generator.export(variables)
+  export_dir = generator.export(variables, global_step=global_step)
   garbage_collect_exports(generator.export_root, keep=keep)
   return export_dir
 
@@ -110,25 +112,47 @@ def write_spec_assets(
     feature_spec: ts.SpecStructure,
     label_spec: Optional[ts.SpecStructure] = None,
     extra: Optional[dict] = None,
+    global_step: int = 0,
 ) -> str:
-  """Writes the spec asset file predictors read the signature from."""
+  """Writes the spec asset files predictors read the signature from.
+
+  Two equivalent assets per export version: human-readable JSON and the
+  language-neutral proto twin (proto/t2r.proto §T2RAssets — reference
+  parity: proto-serialized spec assets alongside SavedModels).
+  """
   payload = {
       "feature_spec": json.loads(ts.to_serialized(feature_spec)),
       "label_spec": (json.loads(ts.to_serialized(label_spec))
                      if label_spec is not None else None),
       "extra": extra or {},
+      "global_step": int(global_step),
   }
   path = os.path.join(export_dir, SPEC_ASSET_NAME)
   with open(path, "w") as f:
     json.dump(payload, f, indent=2, sort_keys=True)
+  from tensor2robot_tpu.proto import proto_utils
+  assets = proto_utils.make_t2r_assets(
+      feature_spec, label_spec, extra=extra, global_step=global_step)
+  with open(os.path.join(export_dir, SPEC_ASSET_PB_NAME), "wb") as f:
+    f.write(assets.SerializeToString())
   return path
 
 
 def read_spec_assets(
     export_dir: str,
 ) -> Tuple[ts.TensorSpecStruct, Optional[ts.TensorSpecStruct], dict]:
-  """Reads back (feature_spec, label_spec, extra)."""
+  """Reads back (feature_spec, label_spec, extra).
+
+  Prefers the JSON asset; falls back to the proto twin so artifacts
+  written by non-Python exporters (proto only) still load.
+  """
   path = os.path.join(export_dir, SPEC_ASSET_NAME)
+  if not os.path.exists(path):
+    from tensor2robot_tpu.proto import proto_utils, t2r_pb2
+    pb_path = os.path.join(export_dir, SPEC_ASSET_PB_NAME)
+    with open(pb_path, "rb") as f:
+      assets = t2r_pb2.T2RAssets.FromString(f.read())
+    return proto_utils.parse_t2r_assets(assets)
   with open(path) as f:
     payload = json.load(f)
   feature_spec = ts.from_serialized(json.dumps(payload["feature_spec"]))

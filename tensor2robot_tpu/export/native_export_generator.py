@@ -1,4 +1,4 @@
-"""Native export: jax.export (StableHLO) + orbax variables + spec assets.
+"""Native export: jax.export (StableHLO) + npz variables + spec assets.
 
 The TPU-native serving format (replaces the reference's SavedModel for
 pure-JAX consumers): the PREDICT computation is serialized as portable
@@ -9,8 +9,10 @@ SURVEY.md §3.3's SavedModel contract.
 Artifact layout (one versioned dir):
     serving_fn.bin     jax.export.Exported.serialize() of
                        serve(variables, *features_in_key_order) -> {name: out}
-    variables/         orbax StandardCheckpointer save of the variables dict
+    variables.npz      flat npz of the variables dict (export/variables_io.py;
+                       numpy is the only robot-side dependency)
     t2r_assets.json    feature specs + feature key order + metadata
+    t2r_assets.pb      proto twin of the JSON assets (proto/t2r.proto)
 
 Batch dim is exported symbolically ("b") so serving batch size is free —
 QT-Opt's CEM sweeps batch sizes at inference (SURVEY.md §3.3).
@@ -23,15 +25,15 @@ from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
-import orbax.checkpoint as ocp
 
-from tensor2robot_tpu.export import export_utils
+from tensor2robot_tpu.export import export_utils, variables_io
 from tensor2robot_tpu.export.abstract_export_generator import (
     AbstractExportGenerator,
 )
 
 SERVING_FN_NAME = "serving_fn.bin"
-VARIABLES_DIR = "variables"
+VARIABLES_DIR = "variables"  # legacy orbax layout, still readable
+VARIABLES_NPZ = "variables.npz"
 
 
 class NativeExportGenerator(AbstractExportGenerator):
@@ -47,7 +49,7 @@ class NativeExportGenerator(AbstractExportGenerator):
     self._platforms = tuple(platforms)
     self._polymorphic_batch = polymorphic_batch
 
-  def export(self, variables: Any) -> str:
+  def export(self, variables: Any, global_step: int = 0) -> str:
     model = self._model
     feature_spec = self.feature_spec
     keys = list(feature_spec.keys())
@@ -78,18 +80,17 @@ class NativeExportGenerator(AbstractExportGenerator):
     os.makedirs(tmp_dir, exist_ok=True)
     with open(os.path.join(tmp_dir, SERVING_FN_NAME), "wb") as f:
       f.write(exported.serialize())
-    checkpointer = ocp.StandardCheckpointer()
-    checkpointer.save(
-        os.path.abspath(os.path.join(tmp_dir, VARIABLES_DIR)), variables)
-    # StandardCheckpointer writes asynchronously; the atomic publish rename
-    # below must not race the background serialization.
-    checkpointer.wait_until_finished()
-    checkpointer.close()
+    # Variables as one flat npz (variables_io): numpy-only on the robot
+    # side, and no checkpoint-library global state in this (possibly
+    # worker) thread while the trainer checkpoints concurrently.
+    variables_io.save_variables(
+        os.path.join(tmp_dir, VARIABLES_NPZ), variables)
     export_utils.write_spec_assets(
         tmp_dir, feature_spec,
         extra={
             "format": "jax_export_stablehlo",
             "feature_keys": keys,
             "platforms": list(self._platforms),
-        })
+        },
+        global_step=global_step)
     return export_utils.publish(tmp_dir, final_dir)

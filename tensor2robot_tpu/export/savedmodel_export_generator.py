@@ -38,7 +38,7 @@ class SavedModelExportGenerator(AbstractExportGenerator):
     self._platforms = tuple(platforms)
     self._with_tf_example_signature = with_tf_example_signature
 
-  def export(self, variables: Any) -> str:
+  def export(self, variables: Any, global_step: int = 0) -> str:
     import tensorflow as tf
     from jax.experimental import jax2tf
 
@@ -106,7 +106,8 @@ class SavedModelExportGenerator(AbstractExportGenerator):
     export_utils.write_spec_assets(
         tmp_dir, feature_spec,
         extra={"format": "tf_saved_model", "feature_keys": keys,
-               "platforms": list(self._platforms)})
+               "platforms": list(self._platforms)},
+        global_step=global_step)
     return export_utils.publish(tmp_dir, final_dir)
 
   @staticmethod

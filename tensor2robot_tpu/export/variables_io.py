@@ -1,0 +1,118 @@
+"""Self-contained variables artifact: one flat .npz, no checkpoint deps.
+
+The native serving artifact stores model variables as a single npz file
+(flat "/"-joined tree paths + an embedded JSON manifest) instead of a
+training-checkpoint directory. Two reasons:
+
+1. Robot-side consumers (predictors/) need only numpy to load a model —
+   no orbax/tensorstore on the robot (the reference's equivalent
+   decoupling: robots load SavedModels, never trainer checkpoints;
+   SURVEY.md §3.3).
+2. The async export hook writes from a worker thread while the trainer's
+   orbax CheckpointManager may be mid-save on its own background thread
+   (hooks/async_export_hook.py). Keeping the export path free of the
+   checkpoint library's global state removes that thread-safety coupling.
+
+Non-numpy-native dtypes (bfloat16 etc. from ml_dtypes) are stored as raw
+byte views with the true dtype recorded in the manifest.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+MANIFEST_KEY = "__t2r_manifest__"
+_EMPTY_DICTS_KEY = "__empty_dicts__"
+_RESERVED_KEYS = (MANIFEST_KEY, _EMPTY_DICTS_KEY)
+_SEP = "/"
+
+
+def _flatten(variables: Mapping[str, Any], prefix: str = "",
+             out: Dict[str, np.ndarray] = None,
+             empty: list = None) -> Dict[str, np.ndarray]:
+  if out is None:
+    out = {}
+  if empty is None:
+    empty = []
+  if prefix and not variables:
+    # Empty collections (e.g. a stateless model's batch_stats) must
+    # survive the round trip: the serving fn was traced with the exact
+    # variables pytree, so dropping them breaks the serve-time call.
+    empty.append(prefix)
+    return out
+  for key, value in variables.items():
+    if not isinstance(key, str):
+      raise TypeError(f"Variable tree keys must be str, got {key!r}")
+    if _SEP in key:
+      raise ValueError(f"Variable name may not contain '{_SEP}': {key!r}")
+    if key in _RESERVED_KEYS:
+      raise ValueError(f"Variable name {key!r} is reserved")
+    path = f"{prefix}{_SEP}{key}" if prefix else key
+    if isinstance(value, Mapping):
+      _flatten(value, path, out, empty)
+    else:
+      out[path] = np.asarray(value)
+  return out
+
+
+def _unflatten(flat: Mapping[str, np.ndarray],
+               empty_dicts: list = ()) -> Dict[str, Any]:
+  tree: Dict[str, Any] = {}
+  for path in empty_dicts:
+    node = tree
+    for part in path.split(_SEP):
+      node = node.setdefault(part, {})
+  for path, value in flat.items():
+    parts = path.split(_SEP)
+    node = tree
+    for part in parts[:-1]:
+      node = node.setdefault(part, {})
+    node[parts[-1]] = value
+  return tree
+
+
+def save_variables(path: str, variables: Mapping[str, Any]) -> None:
+  """Writes a nested {str: array} tree to one npz file at `path`."""
+  empty: list = []
+  flat = _flatten(variables, empty=empty)
+  manifest = {_EMPTY_DICTS_KEY: sorted(empty)}
+  arrays = {}
+  for key, value in flat.items():
+    manifest[key] = {"dtype": value.dtype.name,
+                     "shape": list(value.shape)}
+    if value.dtype.kind == "V" or not value.dtype.isbuiltin:
+      # ml_dtypes (bfloat16, float8_*) round-trip as byte views. Flatten
+      # first: 0-d arrays reject itemsize-changing views, and the true
+      # shape is restored from the manifest on load anyway.
+      value = np.ascontiguousarray(value).reshape(-1).view(np.uint8)
+    arrays[key] = value
+  arrays[MANIFEST_KEY] = np.frombuffer(
+      json.dumps(manifest, sort_keys=True).encode("utf-8"), dtype=np.uint8)
+  with open(path, "wb") as f:
+    np.savez(f, **arrays)
+
+
+def load_variables(path: str) -> Dict[str, Any]:
+  """Inverse of `save_variables`; returns nested dicts of numpy arrays."""
+  with np.load(path) as data:
+    manifest = json.loads(bytes(data[MANIFEST_KEY]).decode("utf-8"))
+    empty_dicts = manifest.pop(_EMPTY_DICTS_KEY, [])
+    flat = {}
+    for key, meta in manifest.items():
+      value = data[key]
+      dtype = _lookup_dtype(meta["dtype"])
+      if value.dtype != dtype:
+        value = value.view(dtype).reshape(meta["shape"])
+      flat[key] = value
+  return _unflatten(flat, empty_dicts)
+
+
+def _lookup_dtype(name: str) -> np.dtype:
+  try:
+    return np.dtype(name)
+  except TypeError:
+    import ml_dtypes
+    return np.dtype(getattr(ml_dtypes, name))

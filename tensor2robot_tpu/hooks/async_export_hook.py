@@ -16,6 +16,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import jax
@@ -61,7 +62,7 @@ class AsyncExportHook(Hook):
     # Snapshot on the host: the donated device buffers are reused by the
     # next step, so the worker must not touch them.
     variables = jax.device_get(state.variables(use_ema=True))
-    self._submit(variables)
+    self._submit((variables, int(state.step)))
     self._last_submitted_step = int(state.step)
 
   def _run(self) -> None:
@@ -69,23 +70,45 @@ class AsyncExportHook(Hook):
       item = self._pending.get()
       if item is self._stop:
         return
+      variables, step = item
       try:
         export_dir = export_utils.export_and_gc(
-            self._generator, item, keep=self._keep)
+            self._generator, variables, keep=self._keep, global_step=step)
         _log.info("Async export published %s", export_dir)
       except Exception:
         _log.exception("Async export failed; training continues.")
 
-  def end(self, state) -> None:
+  def end(self, state, shutdown_timeout_s: float = 180.0) -> None:
     # Drain, exporting the final state unless the final checkpoint already
-    # submitted this exact step. Blocking puts (not _submit): the stop
-    # signal must never displace a queued final export.
+    # submitted this exact step. Ordered, deadline-bounded puts (not
+    # _submit): the stop signal must never displace a queued final
+    # export, and a hung worker must never block shutdown past the
+    # deadline (the worker is a daemon thread: abandoning it cannot
+    # block interpreter exit).
+    if self._worker is None:
+      _log.warning("AsyncExportHook.end called without begin; no export "
+                   "worker exists, nothing to export.")
+      return
+    deadline = time.monotonic() + shutdown_timeout_s
+    submitted = True
     if self._last_submitted_step != int(state.step):
       variables = jax.device_get(state.variables(use_ema=True))
-      self._pending.put(variables)
-    self._pending.put(self._stop)
-    if self._worker is not None:
-      self._worker.join(timeout=600)
+      submitted = self._put_with_deadline((variables, int(state.step)),
+                                          deadline)
+    if submitted and self._put_with_deadline(self._stop, deadline):
+      self._worker.join(timeout=max(0.0, deadline - time.monotonic()))
+      if not self._worker.is_alive():
+        return
+    _log.error("Async export worker did not finish within %.0fs; "
+               "abandoning it (final export may be missing).",
+               shutdown_timeout_s)
+
+  def _put_with_deadline(self, item, deadline: float) -> bool:
+    try:
+      self._pending.put(item, timeout=max(0.0, deadline - time.monotonic()))
+      return True
+    except queue.Full:
+      return False
 
 
 class AsyncExportHookBuilder(HookBuilder):

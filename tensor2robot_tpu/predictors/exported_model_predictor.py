@@ -15,12 +15,12 @@ from typing import Dict, Optional
 
 import jax
 import numpy as np
-import orbax.checkpoint as ocp
 
-from tensor2robot_tpu.export import export_utils
+from tensor2robot_tpu.export import export_utils, variables_io
 from tensor2robot_tpu.export.native_export_generator import (
     SERVING_FN_NAME,
     VARIABLES_DIR,
+    VARIABLES_NPZ,
 )
 from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
 from tensor2robot_tpu.specs import tensorspec_utils as ts
@@ -46,8 +46,13 @@ class ExportedModelPredictor(AbstractPredictor):
     export_dir = os.path.join(self._export_root, str(newest))
     with open(os.path.join(export_dir, SERVING_FN_NAME), "rb") as f:
       exported = jax.export.deserialize(bytearray(f.read()))
-    variables = ocp.StandardCheckpointer().restore(
-        os.path.abspath(os.path.join(export_dir, VARIABLES_DIR)))
+    npz_path = os.path.join(export_dir, VARIABLES_NPZ)
+    if os.path.exists(npz_path):
+      variables = variables_io.load_variables(npz_path)
+    else:  # legacy orbax-layout artifact
+      import orbax.checkpoint as ocp
+      variables = ocp.StandardCheckpointer().restore(
+          os.path.abspath(os.path.join(export_dir, VARIABLES_DIR)))
     feature_spec, _, extra = export_utils.read_spec_assets(export_dir)
     self._call = jax.jit(exported.call)
     self._variables = jax.tree_util.tree_map(jax.numpy.asarray, variables)

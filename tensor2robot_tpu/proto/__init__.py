@@ -1,0 +1,3 @@
+"""Protobuf schema for specs + serving metadata (reference: proto/t2r.proto)."""
+
+from tensor2robot_tpu.proto import t2r_pb2

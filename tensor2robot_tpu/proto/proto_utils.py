@@ -1,0 +1,107 @@
+"""Spec structure ↔ protobuf conversion.
+
+Reference parity: the reference serializes its spec system through
+proto/t2r.proto (SURVEY.md §2 "Proto") so exported artifacts carry their
+input signature in a language-neutral form. These converters are the
+binary twin of `tensorspec_utils.to_serialized`/`from_serialized` (JSON):
+both round-trip `TensorSpecStruct`s exactly; the proto form additionally
+carries the global step and exporter metadata (`T2RAssets`).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Mapping, Optional, Tuple
+
+from tensor2robot_tpu.proto import t2r_pb2
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+def spec_to_proto(
+    spec: ts.ExtendedTensorSpec,
+    out: Optional[t2r_pb2.ExtendedTensorSpecProto] = None,
+) -> t2r_pb2.ExtendedTensorSpecProto:
+  """ExtendedTensorSpec → ExtendedTensorSpecProto."""
+  proto = out if out is not None else t2r_pb2.ExtendedTensorSpecProto()
+  proto.shape.extend(int(d) for d in spec.shape)
+  proto.dtype = spec.dtype.name
+  proto.name = spec.name or ""
+  proto.is_optional = spec.is_optional
+  proto.is_sequence = spec.is_sequence
+  proto.data_format = spec.data_format or ""
+  proto.dataset_key = spec.dataset_key
+  if spec.varlen_default_value is not None:
+    proto.varlen_default_value.value = float(spec.varlen_default_value)
+  return proto
+
+
+def proto_to_spec(
+    proto: t2r_pb2.ExtendedTensorSpecProto) -> ts.ExtendedTensorSpec:
+  """ExtendedTensorSpecProto → ExtendedTensorSpec."""
+  varlen = None
+  if proto.HasField("varlen_default_value"):
+    varlen = proto.varlen_default_value.value
+  return ts.ExtendedTensorSpec(
+      shape=tuple(proto.shape),
+      dtype=proto.dtype,
+      name=proto.name or None,
+      is_optional=proto.is_optional,
+      is_sequence=proto.is_sequence,
+      data_format=proto.data_format or None,
+      dataset_key=proto.dataset_key,
+      varlen_default_value=varlen,
+  )
+
+
+def struct_to_proto(
+    spec_structure: ts.SpecStructure,
+    out: Optional[t2r_pb2.TensorSpecStructProto] = None,
+) -> t2r_pb2.TensorSpecStructProto:
+  """Any spec structure → flattened, order-preserving proto."""
+  proto = out if out is not None else t2r_pb2.TensorSpecStructProto()
+  flat = ts.flatten_spec_structure(spec_structure)
+  for key, spec in flat.items():
+    entry = proto.entries.add()
+    entry.key = key
+    spec_to_proto(spec, out=entry.spec)
+  return proto
+
+
+def proto_to_struct(
+    proto: t2r_pb2.TensorSpecStructProto) -> ts.TensorSpecStruct:
+  """Inverse of `struct_to_proto` (always returns the flattened view)."""
+  struct = ts.TensorSpecStruct()
+  for entry in proto.entries:
+    struct[entry.key] = proto_to_spec(entry.spec)
+  return struct
+
+
+def make_t2r_assets(
+    feature_spec: ts.SpecStructure,
+    label_spec: Optional[ts.SpecStructure] = None,
+    extra: Optional[Mapping[str, Any]] = None,
+    global_step: int = 0,
+) -> t2r_pb2.T2RAssets:
+  """Builds the serving-metadata proto written next to every export.
+
+  `extra` values are JSON-encoded so arbitrary exporter metadata
+  (lists, dicts) survives the string-map wire type.
+  """
+  assets = t2r_pb2.T2RAssets(global_step=int(global_step))
+  struct_to_proto(feature_spec, out=assets.feature_spec)
+  if label_spec is not None:
+    struct_to_proto(label_spec, out=assets.label_spec)
+  for key, value in (extra or {}).items():
+    assets.extra[str(key)] = json.dumps(value)
+  return assets
+
+
+def parse_t2r_assets(
+    assets: t2r_pb2.T2RAssets,
+) -> Tuple[ts.TensorSpecStruct, Optional[ts.TensorSpecStruct], dict]:
+  """T2RAssets → (feature_spec, label_spec, extra dict)."""
+  feature_spec = proto_to_struct(assets.feature_spec)
+  label_spec = (proto_to_struct(assets.label_spec)
+                if assets.HasField("label_spec") else None)
+  extra = {key: json.loads(value) for key, value in assets.extra.items()}
+  return feature_spec, label_spec, extra

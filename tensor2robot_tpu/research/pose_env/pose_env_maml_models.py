@@ -1,0 +1,53 @@
+"""Pose-env MAML models: meta-learned variant of the pose regressor.
+
+Reference parity: research/pose_env/pose_env_maml_models.py
+§PoseEnvRegressionModelMAML (SURVEY.md §2 "pose_env research") — the
+reference wraps its pose regression model in MAMLModel so each simulated
+task (a scene with a different target pose) is adapted from a handful of
+condition episodes before the query prediction. Same structure here: the
+base model is research/pose_env/pose_env_models.py
+§PoseEnvRegressionModel and the wrapper is
+meta_learning/maml_model.py §MAMLModel (jax.grad inner loop).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.meta_learning import MAMLModel
+from tensor2robot_tpu.research.pose_env.pose_env_models import (
+    PoseEnvRegressionModel,
+)
+
+
+@configurable
+def pose_env_maml_model(
+    num_inner_steps: int = 1,
+    inner_lr: float = 0.01,
+    learn_inner_lr: bool = False,
+    first_order: bool = False,
+    num_condition_samples: int = 4,
+    num_inference_samples: int = 4,
+    **base_kwargs,
+) -> MAMLModel:
+  """Builds the meta-learned pose regressor (PoseEnvRegressionModelMAML).
+
+  float32 compute: MAML inner-loop gradients are unstable in bfloat16
+  (same stance as vrgripper_env_models.vrgripper_maml_model).
+  """
+  base_kwargs.setdefault("compute_dtype", jnp.float32)
+  base = PoseEnvRegressionModel(**base_kwargs)
+  return MAMLModel(
+      base,
+      num_inner_steps=num_inner_steps,
+      inner_lr=inner_lr,
+      learn_inner_lr=learn_inner_lr,
+      first_order=first_order,
+      num_condition_samples=num_condition_samples,
+      num_inference_samples=num_inference_samples)
+
+
+# Class-style alias matching the reference's naming, for config files
+# that instantiate by class name.
+PoseEnvRegressionModelMAML = pose_env_maml_model

@@ -187,7 +187,7 @@ def train_eval_model(
     export_generator.set_specification_from_model(model)
     export_dir = export_utils.export_and_gc(
         export_generator, jax.device_get(state.variables(use_ema=True)),
-        keep=export_keep)
+        keep=export_keep, global_step=int(state.step))
     _log.info("Exported final model to %s", export_dir)
 
   for hook in hooks:

@@ -1,0 +1,117 @@
+"""Tests for the t2r.proto spec/asset wire format (proto/proto_utils.py)."""
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.proto import proto_utils, t2r_pb2
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+def _rich_spec_struct() -> ts.TensorSpecStruct:
+  struct = ts.TensorSpecStruct()
+  struct["state/camera_image"] = ts.ExtendedTensorSpec(
+      (64, 64, 3), np.uint8, name="image", data_format="jpeg")
+  struct["state/pose"] = ts.ExtendedTensorSpec(
+      (7,), np.float32, is_optional=True, dataset_key="aux")
+  struct["action"] = ts.ExtendedTensorSpec(
+      (4,), "bfloat16", is_sequence=True, varlen_default_value=-1.0)
+  struct["reward"] = ts.ExtendedTensorSpec((), np.float32)
+  return struct
+
+
+class TestSpecProtoRoundTrip:
+
+  def test_single_spec_round_trip(self):
+    spec = ts.ExtendedTensorSpec(
+        (3, 4), np.float32, name="x", is_optional=True, is_sequence=True,
+        data_format="png", dataset_key="d2", varlen_default_value=0.5)
+    back = proto_utils.proto_to_spec(proto_utils.spec_to_proto(spec))
+    assert back == spec
+
+  def test_varlen_zero_vs_unset(self):
+    # proto3 has no scalar presence; the wrapper must distinguish
+    # varlen_default_value=0.0 from "not a varlen feature".
+    with_zero = ts.ExtendedTensorSpec((2,), np.float32,
+                                      varlen_default_value=0.0)
+    without = ts.ExtendedTensorSpec((2,), np.float32)
+    assert proto_utils.proto_to_spec(
+        proto_utils.spec_to_proto(with_zero)).varlen_default_value == 0.0
+    assert proto_utils.proto_to_spec(
+        proto_utils.spec_to_proto(without)).varlen_default_value is None
+
+  def test_struct_round_trip_preserves_order_and_fields(self):
+    struct = _rich_spec_struct()
+    wire = proto_utils.struct_to_proto(struct).SerializeToString()
+    back = proto_utils.proto_to_struct(
+        t2r_pb2.TensorSpecStructProto.FromString(wire))
+    assert list(back.keys()) == list(struct.keys())
+    for key in struct:
+      assert back[key] == struct[key], key
+
+  def test_scalar_shape_survives(self):
+    struct = ts.TensorSpecStruct()
+    struct["r"] = ts.ExtendedTensorSpec((), np.int64)
+    back = proto_utils.proto_to_struct(proto_utils.struct_to_proto(struct))
+    assert back["r"].shape == ()
+    assert back["r"].dtype == np.dtype(np.int64)
+
+
+class TestT2RAssets:
+
+  def test_assets_round_trip(self):
+    feature_spec = _rich_spec_struct()
+    label_spec = ts.TensorSpecStruct()
+    label_spec["target"] = ts.ExtendedTensorSpec((2,), np.float32)
+    assets = proto_utils.make_t2r_assets(
+        feature_spec, label_spec,
+        extra={"format": "native", "platforms": ["cpu", "tpu"]},
+        global_step=1234)
+    wire = assets.SerializeToString()
+    f, l, extra = proto_utils.parse_t2r_assets(
+        t2r_pb2.T2RAssets.FromString(wire))
+    assert list(f.keys()) == list(feature_spec.keys())
+    assert l is not None and l["target"] == label_spec["target"]
+    assert extra == {"format": "native", "platforms": ["cpu", "tpu"]}
+    assert t2r_pb2.T2RAssets.FromString(wire).global_step == 1234
+
+  def test_assets_without_label_spec(self):
+    assets = proto_utils.make_t2r_assets(_rich_spec_struct())
+    _, l, extra = proto_utils.parse_t2r_assets(
+        t2r_pb2.T2RAssets.FromString(assets.SerializeToString()))
+    assert l is None
+    assert extra == {}
+
+
+class TestExportAssetInterop:
+
+  def test_export_writes_pb_twin_and_json_fallback(self, tmp_path):
+    from tensor2robot_tpu.export import export_utils
+    feature_spec = _rich_spec_struct()
+    export_dir = str(tmp_path)
+    export_utils.write_spec_assets(
+        export_dir, feature_spec, extra={"format": "native"}, global_step=7)
+    import os
+    assert os.path.isfile(
+        os.path.join(export_dir, export_utils.SPEC_ASSET_NAME))
+    assert os.path.isfile(
+        os.path.join(export_dir, export_utils.SPEC_ASSET_PB_NAME))
+    f1, _, e1 = export_utils.read_spec_assets(export_dir)
+    import json as _json
+    payload = _json.load(
+        open(os.path.join(export_dir, export_utils.SPEC_ASSET_NAME)))
+    assert payload["global_step"] == 7
+    from tensor2robot_tpu.proto import t2r_pb2
+    pb = t2r_pb2.T2RAssets.FromString(
+        open(os.path.join(export_dir, export_utils.SPEC_ASSET_PB_NAME),
+             "rb").read())
+    assert pb.global_step == 7
+    # Remove the JSON asset: the proto fallback must read identically.
+    os.unlink(os.path.join(export_dir, export_utils.SPEC_ASSET_NAME))
+    f2, _, e2 = export_utils.read_spec_assets(export_dir)
+    # JSON assets are written key-sorted; the proto twin preserves
+    # insertion order (positional serving order travels separately in
+    # extra["feature_keys"]). Compare order-insensitively.
+    assert sorted(f1.keys()) == sorted(f2.keys())
+    for key in f1:
+      assert f1[key] == f2[key], key
+    assert e1["format"] == e2["format"] == "native"

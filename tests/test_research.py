@@ -147,3 +147,51 @@ class TestQTOpt:
     action = policy(np.zeros((64, 64, 3), np.float32))
     assert action.shape == (4,)
     assert np.all(np.abs(np.asarray(action)) <= 1.0)
+
+
+class TestPoseEnvMAML:
+
+  def test_maml_variant_trains(self):
+    from tensor2robot_tpu.research.pose_env.pose_env_maml_models import (
+        pose_env_maml_model,
+    )
+    model = pose_env_maml_model(
+        image_size=32, num_condition_samples=2, num_inference_samples=2)
+    T2RModelFixture().random_train(model, max_train_steps=1, batch_size=8)
+
+
+class TestResearchConfigs:
+  """Every shipped research config must parse and build its model."""
+
+  CONFIGS = [
+      ("tensor2robot_tpu/research/pose_env/configs/pose_env_train.cfg",
+       "tensor2robot_tpu.research.pose_env.pose_env_models"),
+      ("tensor2robot_tpu/research/pose_env/configs/pose_env_maml_train.cfg",
+       "tensor2robot_tpu.research.pose_env.pose_env_maml_models"),
+      ("tensor2robot_tpu/research/qtopt/configs/qtopt_train.cfg",
+       "tensor2robot_tpu.research.qtopt.t2r_models"),
+      ("tensor2robot_tpu/research/grasp2vec/configs/grasp2vec_train.cfg",
+       "tensor2robot_tpu.research.grasp2vec.grasp2vec_model"),
+      ("tensor2robot_tpu/research/vrgripper/configs/vrgripper_train.cfg",
+       "tensor2robot_tpu.research.vrgripper.vrgripper_env_models"),
+  ]
+
+  @pytest.mark.parametrize("cfg_path,module", CONFIGS)
+  def test_config_builds_model(self, cfg_path, module):
+    import importlib
+    import os as _os
+
+    from tensor2robot_tpu.config import config as cfg_lib
+    from tensor2robot_tpu.config import registrations  # noqa: F401
+    from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+
+    importlib.import_module(module)
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(
+        __file__)))
+    try:
+      cfg_lib.parse_config_files_and_bindings(
+          [_os.path.join(repo_root, cfg_path)], [])
+      model = cfg_lib.query_binding("train_eval_model.model")
+      assert isinstance(model, AbstractT2RModel)
+    finally:
+      cfg_lib.clear_config()

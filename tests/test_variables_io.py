@@ -1,0 +1,91 @@
+"""Tests for the npz variables artifact (export/variables_io.py)."""
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.export import variables_io
+
+
+class TestVariablesIO:
+
+  def test_nested_round_trip(self, tmp_path):
+    variables = {
+        "params": {
+            "dense": {"kernel": np.arange(6, dtype=np.float32).reshape(2, 3),
+                      "bias": np.zeros((3,), np.float32)},
+            "conv": {"kernel": np.ones((1, 1, 2, 4), np.float16)},
+        },
+        "batch_stats": {"bn": {"mean": np.full((4,), 2.5, np.float64)}},
+    }
+    path = str(tmp_path / "v.npz")
+    variables_io.save_variables(path, variables)
+    back = variables_io.load_variables(path)
+    assert set(back) == {"params", "batch_stats"}
+    np.testing.assert_array_equal(back["params"]["dense"]["kernel"],
+                                  variables["params"]["dense"]["kernel"])
+    assert back["params"]["conv"]["kernel"].dtype == np.float16
+    np.testing.assert_array_equal(back["batch_stats"]["bn"]["mean"],
+                                  variables["batch_stats"]["bn"]["mean"])
+
+  def test_bfloat16_round_trip(self, tmp_path):
+    import ml_dtypes
+    variables = {"params": {"w": np.arange(8, dtype=np.float32).astype(
+        ml_dtypes.bfloat16).reshape(2, 4)}}
+    path = str(tmp_path / "v.npz")
+    variables_io.save_variables(path, variables)
+    back = variables_io.load_variables(path)
+    w = back["params"]["w"]
+    assert w.dtype == np.dtype(ml_dtypes.bfloat16)
+    assert w.shape == (2, 4)
+    np.testing.assert_array_equal(w.astype(np.float32),
+                                  np.arange(8, dtype=np.float32).reshape(
+                                      2, 4))
+
+  def test_zero_d_bfloat16(self, tmp_path):
+    # 0-d arrays reject itemsize-changing views; the byte-view branch
+    # must flatten first (regression: save crashed on scalar bf16 leaves).
+    import ml_dtypes
+    variables = {"params": {"t": np.asarray(1.5, ml_dtypes.bfloat16)}}
+    path = str(tmp_path / "v.npz")
+    variables_io.save_variables(path, variables)
+    back = variables_io.load_variables(path)
+    assert back["params"]["t"].shape == ()
+    assert float(back["params"]["t"].astype(np.float32)) == 1.5
+
+  def test_scalar_and_int_leaves(self, tmp_path):
+    variables = {"opt": {"count": np.int64(7),
+                         "nested": {"eps": np.float32(1e-8)}}}
+    path = str(tmp_path / "v.npz")
+    variables_io.save_variables(path, variables)
+    back = variables_io.load_variables(path)
+    assert back["opt"]["count"] == 7
+    assert back["opt"]["nested"]["eps"].dtype == np.float32
+
+  def test_empty_subdicts_survive(self, tmp_path):
+    # The serving fn is traced with the exact variables pytree; empty
+    # collections must not vanish (regression: tree structure mismatch
+    # at serve time for stateless models with e.g. empty batch_stats).
+    import jax
+    variables = {"params": {"w": np.zeros((2,), np.float32)},
+                 "batch_stats": {}, "cache": {"inner": {}}}
+    path = str(tmp_path / "v.npz")
+    variables_io.save_variables(path, variables)
+    back = variables_io.load_variables(path)
+    assert jax.tree_util.tree_structure(back) == \
+        jax.tree_util.tree_structure(variables)
+
+  def test_rejects_reserved_key(self, tmp_path):
+    with pytest.raises(ValueError, match="reserved"):
+      variables_io.save_variables(
+          str(tmp_path / "v.npz"),
+          {"__empty_dicts__": np.zeros(2)})
+
+  def test_rejects_slash_in_key(self, tmp_path):
+    with pytest.raises(ValueError, match="may not contain"):
+      variables_io.save_variables(
+          str(tmp_path / "v.npz"), {"a/b": np.zeros(2)})
+
+  def test_rejects_non_str_key(self, tmp_path):
+    with pytest.raises(TypeError, match="must be str"):
+      variables_io.save_variables(str(tmp_path / "v.npz"),
+                                  {1: np.zeros(2)})
