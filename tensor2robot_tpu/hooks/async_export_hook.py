@@ -30,9 +30,11 @@ _log = logging.getLogger(__name__)
 class AsyncExportHook(Hook):
   """Exports on checkpoint saves via a worker thread."""
 
-  def __init__(self, export_generator, keep: int = 5):
+  def __init__(self, export_generator, keep: int = 5,
+               shutdown_timeout_s: float = 180.0):
     self._generator = export_generator
     self._keep = keep
+    self._shutdown_timeout_s = shutdown_timeout_s
     # maxsize=1 + replace-on-full: at most one pending export.
     self._pending: "queue.Queue" = queue.Queue(maxsize=1)
     self._worker: Optional[threading.Thread] = None
@@ -78,7 +80,7 @@ class AsyncExportHook(Hook):
       except Exception:
         _log.exception("Async export failed; training continues.")
 
-  def end(self, state, shutdown_timeout_s: float = 180.0) -> None:
+  def end(self, state) -> None:
     # Drain, exporting the final state unless the final checkpoint already
     # submitted this exact step. Ordered, deadline-bounded puts (not
     # _submit): the stop signal must never displace a queued final
@@ -89,7 +91,7 @@ class AsyncExportHook(Hook):
       _log.warning("AsyncExportHook.end called without begin; no export "
                    "worker exists, nothing to export.")
       return
-    deadline = time.monotonic() + shutdown_timeout_s
+    deadline = time.monotonic() + self._shutdown_timeout_s
     submitted = True
     if self._last_submitted_step != int(state.step):
       variables = jax.device_get(state.variables(use_ema=True))
@@ -101,7 +103,7 @@ class AsyncExportHook(Hook):
         return
     _log.error("Async export worker did not finish within %.0fs; "
                "abandoning it (final export may be missing).",
-               shutdown_timeout_s)
+               self._shutdown_timeout_s)
 
   def _put_with_deadline(self, item, deadline: float) -> bool:
     try:
@@ -115,9 +117,12 @@ class AsyncExportHookBuilder(HookBuilder):
   """Builds AsyncExportHook (config-injectable; reference
   §AsyncExportHookBuilder)."""
 
-  def __init__(self, export_generator, keep: int = 5):
+  def __init__(self, export_generator, keep: int = 5,
+               shutdown_timeout_s: float = 180.0):
     self._export_generator = export_generator
     self._keep = keep
+    self._shutdown_timeout_s = shutdown_timeout_s
 
   def create_hooks(self, trainer, model_dir: str) -> List[Hook]:
-    return [AsyncExportHook(self._export_generator, keep=self._keep)]
+    return [AsyncExportHook(self._export_generator, keep=self._keep,
+                            shutdown_timeout_s=self._shutdown_timeout_s)]

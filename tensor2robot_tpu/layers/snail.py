@@ -91,6 +91,10 @@ class AttentionBlock(nn.Module):
   dtype: Any = jnp.bfloat16
   seq_mesh: Any = None
   seq_axis: str = "seq"
+  # On dp×sp meshes, name the batch mesh axis so each data row computes
+  # only its batch shard (unset, the ring path would all-gather the
+  # batch and redo identical work per row).
+  batch_axis: Any = None
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -106,7 +110,8 @@ class AttentionBlock(nn.Module):
       read = ring_attention(
           queries[:, :, None, :], keys[:, :, None, :],
           values[:, :, None, :],
-          mesh=self.seq_mesh, axis=self.seq_axis, causal=True)[:, :, 0, :]
+          mesh=self.seq_mesh, axis=self.seq_axis, causal=True,
+          batch_axis=self.batch_axis)[:, :, 0, :]
       return jnp.concatenate([x.astype(self.dtype), read], axis=-1)
     # float32 logits/softmax: attention normalization is precision-
     # sensitive even at short T.

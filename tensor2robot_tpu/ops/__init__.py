@@ -1,0 +1,17 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+XLA fuses most of the elementwise work into the surrounding matmuls
+already; these kernels cover the reductions XLA schedules poorly. Every
+op has an XLA reference implementation, an `implementation="auto"`
+switch, and runs the Pallas path in interpreter mode off-TPU so CPU CI
+tests the same kernel code.
+"""
+
+from tensor2robot_tpu.ops.spatial_softmax import (
+    spatial_softmax,
+    spatial_softmax_reference,
+)
+from tensor2robot_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_reference,
+)

@@ -1,0 +1,171 @@
+"""Blockwise (flash) multi-head attention — Pallas TPU kernel.
+
+Single-device counterpart of parallel/ring_attention.py: the same
+running-max/denominator accumulation, but blocked over VMEM tiles inside
+one chip instead of over ring hops. O(T) HBM traffic for the forward
+pass instead of materializing the (B, H, T, T) score tensor (which is
+what the XLA reference below does). Used for long in-device sequences;
+ring_attention composes it across chips for sequences that exceed one
+device.
+
+Gradient: custom_vjp recomputing through the XLA reference, so training
+at long T should prefer ring_attention (whose accumulation is
+differentiated directly); this kernel's primary consumers are
+inference-time attention (serving, CEM sweeps) and moderate-T training.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK = 128
+_MAX_SINGLE_BLOCK_T = 1024
+
+
+def flash_attention_reference(q, k, v, causal: bool = False,
+                              scale: Optional[float] = None):
+  """XLA reference: materializes (B, H, T, T) scores. (B, T, H, D) in/out."""
+  if scale is None:
+    scale = 1.0 / math.sqrt(q.shape[-1])
+  scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                      k.astype(jnp.float32)) * scale
+  if causal:
+    t = q.shape[1]
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+  weights = jax.nn.softmax(scores, axis=-1)
+  out = jnp.einsum("bhqk,bkhd->bqhd", weights, v.astype(jnp.float32))
+  return out.astype(q.dtype)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+            block_q: int, block_k: int, seq_len: int):
+  """One (block_q, D) query tile vs all K/V tiles of this (b·h) row."""
+  q = q_ref[0].astype(jnp.float32) * scale                 # (BQ, D)
+  qi = pl.program_id(1)
+  head_dim = q.shape[-1]
+
+  def body(kj, carry):
+    m, l, acc = carry
+    k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+    v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                # (BQ, BK)
+    if causal:
+      rows = qi * block_q + jax.lax.broadcasted_iota(
+          jnp.int32, (block_q, block_k), 0)
+      cols = kj * block_k + jax.lax.broadcasted_iota(
+          jnp.int32, (block_q, block_k), 1)
+      s = jnp.where(rows >= cols, s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_blk)
+    safe_max = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    correction = jnp.exp(m - safe_max)
+    p = jnp.exp(s - safe_max)
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * correction + jnp.dot(
+        p, v_blk, preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+  if causal:
+    # Only K blocks that intersect the causal triangle of this Q tile.
+    num_k = (qi * block_q + block_q + block_k - 1) // block_k
+  else:
+    num_k = seq_len // block_k
+  init = (jnp.full((block_q, 1), -jnp.inf, jnp.float32),
+          jnp.zeros((block_q, 1), jnp.float32),
+          jnp.zeros((block_q, head_dim), jnp.float32))
+  _, l, acc = jax.lax.fori_loop(0, num_k, body, init)
+  l = jnp.where(l == 0.0, 1.0, l)
+  o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _block_sizes(t: int):
+  if t % _BLOCK == 0:
+    return _BLOCK, _BLOCK
+  if t <= _MAX_SINGLE_BLOCK_T:
+    return t, t
+  return None
+
+
+def _pallas_forward(q, k, v, causal: bool, scale: float):
+  b, t, h, d = q.shape
+  block_q, block_k = _block_sizes(t)
+  # (B, T, H, D) → (B·H, T, D): heads become independent grid rows.
+  to_rows = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+  qr, kr, vr = to_rows(q), to_rows(k), to_rows(v)
+  grid = (b * h, t // block_q)
+  out = pl.pallas_call(
+      functools.partial(_kernel, scale=scale, causal=causal,
+                        block_q=block_q, block_k=block_k, seq_len=t),
+      out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+      grid=grid,
+      in_specs=[
+          pl.BlockSpec((1, block_q, d), lambda i, qi: (i, qi, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, t, d), lambda i, qi: (i, 0, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, t, d), lambda i, qi: (i, 0, 0),
+                       memory_space=pltpu.VMEM),
+      ],
+      out_specs=pl.BlockSpec((1, block_q, d), lambda i, qi: (i, qi, 0),
+                             memory_space=pltpu.VMEM),
+      interpret=jax.default_backend() != "tpu",
+  )(qr, kr, vr)
+  return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_pallas(q, k, v, causal: bool, scale: float):
+  return _pallas_forward(q, k, v, causal, scale)
+
+
+def _fwd(q, k, v, causal, scale):
+  return _pallas_forward(q, k, v, causal, scale), (q, k, v)
+
+
+def _bwd(causal, scale, residuals, grad):
+  q, k, v = residuals
+  _, vjp = jax.vjp(
+      lambda q, k, v: flash_attention_reference(q, k, v, causal, scale),
+      q, k, v)
+  return vjp(grad)
+
+
+_flash_attention_pallas.defvjp(_fwd, _bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    implementation: str = "auto"):
+  """Multi-head attention over (B, T, H, D) without the (T, T) tensor.
+
+  Args:
+    q, k, v: (B, T, H, D) arrays (same layout as ring_attention).
+    causal: apply a causal mask.
+    scale: attention scale; default 1/sqrt(D).
+    implementation: "pallas", "xla", or "auto" (pallas when T is
+      blockable: divisible by 128 or ≤ 1024 as one block).
+
+  Returns:
+    (B, T, H, D) attention output in q's dtype.
+  """
+  if scale is None:
+    scale = 1.0 / math.sqrt(q.shape[-1])
+  blockable = _block_sizes(q.shape[1]) is not None
+  if implementation == "xla" or (implementation == "auto"
+                                 and not blockable):
+    return flash_attention_reference(q, k, v, causal, scale)
+  if not blockable:
+    raise ValueError(
+        f"flash_attention pallas path needs T divisible by {_BLOCK} or "
+        f"T <= {_MAX_SINGLE_BLOCK_T}; got T={q.shape[1]}.")
+  return _flash_attention_pallas(q, k, v, causal, scale)

@@ -52,11 +52,11 @@ def _ring_attention_local(
     block_max = jnp.max(scores, axis=-1)
     new_max = jnp.maximum(scores_max, block_max)
     # Renormalize both the old accumulator and the new block. Guard
-    # against all--inf rows (fully-masked): exp(-inf - -inf) otherwise.
+    # against all--inf rows (fully-masked): safe_new_max is finite, so
+    # exp(scores_max - safe_new_max) is 0 (not nan) when scores_max is
+    # still -inf.
     safe_new_max = jnp.where(jnp.isneginf(new_max), 0.0, new_max)
-    correction = jnp.exp(
-        jnp.where(jnp.isneginf(scores_max), -jnp.inf, scores_max)
-        - safe_new_max)
+    correction = jnp.exp(scores_max - safe_new_max)
     weights = jnp.exp(scores - safe_new_max[..., None])
     new_denom = denom * correction + jnp.sum(weights, axis=-1)
     block_acc = jnp.einsum("bhqk,bkhd->bqhd", weights,

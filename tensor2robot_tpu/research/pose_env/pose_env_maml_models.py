@@ -48,6 +48,10 @@ def pose_env_maml_model(
       num_inference_samples=num_inference_samples)
 
 
-# Class-style alias matching the reference's naming, for config files
-# that instantiate by class name.
-PoseEnvRegressionModelMAML = pose_env_maml_model
+# Reference-style name, registered as its own configurable so config
+# files may use either `@pose_env_maml_model()` or
+# `@PoseEnvRegressionModelMAML()`.
+PoseEnvRegressionModelMAML = configurable(
+    pose_env_maml_model.__wrapped__
+    if hasattr(pose_env_maml_model, "__wrapped__") else pose_env_maml_model,
+    name="PoseEnvRegressionModelMAML")

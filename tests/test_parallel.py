@@ -94,6 +94,24 @@ class TestSequenceParallelSnail:
     np.testing.assert_allclose(np.asarray(out_ring),
                                np.asarray(out_dense), atol=2e-5)
 
+  def test_snail_attention_ring_dp_sp_mesh(self):
+    # On a dp×sp mesh, batch_axis shards the batch over the data rows
+    # (without it each row would all-gather and redo the whole batch).
+    from tensor2robot_tpu.layers import snail
+    mesh = create_mesh({"data": 2, "seq": 4})
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((4, 16, 8)), jnp.float32)
+    dense = snail.AttentionBlock(key_size=8, value_size=8,
+                                 dtype=jnp.float32)
+    ring = snail.AttentionBlock(key_size=8, value_size=8,
+                                dtype=jnp.float32, seq_mesh=mesh,
+                                batch_axis="data")
+    variables = dense.init(jax.random.key(0), x)
+    out_dense = dense.apply(variables, x)
+    out_ring = ring.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out_ring),
+                               np.asarray(out_dense), atol=2e-5)
+
 
 class TestTensorParallel:
 

@@ -176,6 +176,20 @@ class TestResearchConfigs:
        "tensor2robot_tpu.research.vrgripper.vrgripper_env_models"),
   ]
 
+  def test_reference_style_maml_name(self):
+    from tensor2robot_tpu.config import config as cfg_lib
+    from tensor2robot_tpu.meta_learning import MAMLModel
+    import tensor2robot_tpu.research.pose_env.pose_env_maml_models  # noqa
+    try:
+      cfg_lib.parse_config(
+          "train_eval_model.model = @PoseEnvRegressionModelMAML()\n"
+          "PoseEnvRegressionModelMAML.num_inner_steps = 2\n")
+      model = cfg_lib.query_binding("train_eval_model.model")
+      assert isinstance(model, MAMLModel)
+      assert model.num_inner_steps == 2
+    finally:
+      cfg_lib.clear_config()
+
   @pytest.mark.parametrize("cfg_path,module", CONFIGS)
   def test_config_builds_model(self, cfg_path, module):
     import importlib
