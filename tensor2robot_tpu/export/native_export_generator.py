@@ -73,8 +73,12 @@ class NativeExportGenerator(AbstractExportGenerator):
     var_shapes = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
         variables)
-    exported = jax.export.export(
-        jax.jit(serve), platforms=self._platforms)(var_shapes, *arg_shapes)
+    from tensor2robot_tpu.ops import dispatch
+    with dispatch.xla_only():
+      # Multi-platform artifacts lower every branch for every platform;
+      # compiled Pallas calls cannot lower for the CPU target.
+      exported = jax.export.export(
+          jax.jit(serve), platforms=self._platforms)(var_shapes, *arg_shapes)
 
     tmp_dir, final_dir = export_utils.versioned_export_dir(self.export_root)
     os.makedirs(tmp_dir, exist_ok=True)

@@ -42,6 +42,13 @@ class SavedModelExportGenerator(AbstractExportGenerator):
     import tensorflow as tf
     from jax.experimental import jax2tf
 
+    from tensor2robot_tpu.ops import dispatch
+    # Multi-platform serialization lowers all branches per platform;
+    # Pallas calls can't lower for CPU (ops/dispatch.py).
+    with dispatch.xla_only():
+      return self._export(variables, global_step, tf, jax2tf)
+
+  def _export(self, variables: Any, global_step: int, tf, jax2tf) -> str:
     model = self._model
     feature_spec = self.feature_spec
     keys = list(feature_spec.keys())

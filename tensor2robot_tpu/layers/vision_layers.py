@@ -18,6 +18,10 @@ def spatial_softmax(features: jnp.ndarray,
                     temperature: float = 1.0) -> jnp.ndarray:
   """Expected (x, y) image-coordinates per channel ("feature points").
 
+  Delegates to the fused Pallas kernel (ops/spatial_softmax.py) when the
+  shape fits VMEM, falling back to its XLA reference otherwise — same
+  contract either way.
+
   Args:
     features: (B, H, W, C) activations.
     temperature: softmax temperature.
@@ -27,17 +31,10 @@ def spatial_softmax(features: jnp.ndarray,
     the keypoint pooling the reference used between conv tower and pose
     head.
   """
-  b, h, w, c = features.shape
-  dtype = features.dtype
-  # Stable softmax over space, per (batch, channel).
-  logits = features.astype(jnp.float32).transpose(0, 3, 1, 2)
-  logits = logits.reshape(b, c, h * w) / temperature
-  attention = nn.softmax(logits, axis=-1).reshape(b, c, h, w)
-  xs = jnp.linspace(-1.0, 1.0, w)
-  ys = jnp.linspace(-1.0, 1.0, h)
-  expected_x = jnp.sum(attention * xs[None, None, None, :], axis=(2, 3))
-  expected_y = jnp.sum(attention * ys[None, None, :, None], axis=(2, 3))
-  return jnp.concatenate([expected_x, expected_y], axis=-1).astype(dtype)
+  from tensor2robot_tpu.ops.spatial_softmax import (
+      spatial_softmax as fused_spatial_softmax,
+  )
+  return fused_spatial_softmax(features, temperature)
 
 
 class ImagesToFeatures(nn.Module):

@@ -1,0 +1,33 @@
+"""Trace-time dispatch control for the Pallas ops.
+
+Multi-platform export (jax.export / jax2tf with platforms=("cpu",
+"tpu")) lowers every branch of the computation for every target
+platform — including branches guarded by jax.lax.platform_dependent —
+and a compiled pallas_call cannot lower for CPU. Exporters therefore
+wrap their tracing in `xla_only()`, which makes every op's "auto" path
+pick its XLA reference at trace time. Thread-local, so an async export
+worker forcing XLA does not affect the training step being traced on
+the main thread.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_STATE = threading.local()
+
+
+def use_xla_only() -> bool:
+  return getattr(_STATE, "xla_only", False)
+
+
+@contextlib.contextmanager
+def xla_only():
+  """Within this context, ops' "auto" paths trace the XLA reference."""
+  previous = use_xla_only()
+  _STATE.xla_only = True
+  try:
+    yield
+  finally:
+    _STATE.xla_only = previous

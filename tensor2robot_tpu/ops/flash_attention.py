@@ -12,6 +12,9 @@ Gradient: custom_vjp recomputing through the XLA reference, so training
 at long T should prefer ring_attention (whose accumulation is
 differentiated directly); this kernel's primary consumers are
 inference-time attention (serving, CEM sweeps) and moderate-T training.
+First-order only — custom_vjp does not compose with forward-over-
+reverse, so models differentiated twice (MAML inner loops) must pass
+implementation="xla".
 """
 
 from __future__ import annotations
@@ -25,8 +28,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tensor2robot_tpu.ops import dispatch
+
 _BLOCK = 128
 _MAX_SINGLE_BLOCK_T = 1024
+# K and V are staged whole per (b·h) row; bound their combined VMEM
+# footprint well under the ~16 MB budget (Q/O tiles + f32 working set
+# take the rest). Longer sequences belong to ring_attention.
+_MAX_KV_VMEM_BYTES = 8 * 1024 * 1024
 
 
 def flash_attention_reference(q, k, v, causal: bool = False,
@@ -96,6 +105,20 @@ def _block_sizes(t: int):
   return None
 
 
+def _supported(q, k) -> Optional[str]:
+  """None if the Pallas path can run, else the reason it cannot."""
+  t, d = q.shape[1], q.shape[3]
+  if _block_sizes(t) is None:
+    return (f"T must be divisible by {_BLOCK} or <= "
+            f"{_MAX_SINGLE_BLOCK_T}; got T={t}")
+  kv_bytes = 2 * t * d * k.dtype.itemsize
+  if kv_bytes > _MAX_KV_VMEM_BYTES:
+    return (f"K+V row ({kv_bytes} bytes at T={t}, D={d}) exceeds the "
+            f"{_MAX_KV_VMEM_BYTES}-byte VMEM budget; use "
+            "ring_attention for sequences this long")
+  return None
+
+
 def _pallas_forward(q, k, v, causal: bool, scale: float):
   b, t, h, d = q.shape
   block_q, block_k = _block_sizes(t)
@@ -158,14 +181,17 @@ def flash_attention(q, k, v, causal: bool = False,
   Returns:
     (B, T, H, D) attention output in q's dtype.
   """
+  if implementation not in ("auto", "pallas", "xla"):
+    raise ValueError(
+        f"implementation must be 'auto', 'pallas', or 'xla'; got "
+        f"{implementation!r}")
   if scale is None:
     scale = 1.0 / math.sqrt(q.shape[-1])
-  blockable = _block_sizes(q.shape[1]) is not None
+  unsupported = _supported(q, k)
   if implementation == "xla" or (implementation == "auto"
-                                 and not blockable):
+                                 and (unsupported is not None
+                                      or dispatch.use_xla_only())):
     return flash_attention_reference(q, k, v, causal, scale)
-  if not blockable:
-    raise ValueError(
-        f"flash_attention pallas path needs T divisible by {_BLOCK} or "
-        f"T <= {_MAX_SINGLE_BLOCK_T}; got T={q.shape[1]}.")
+  if unsupported is not None:
+    raise ValueError(f"flash_attention pallas path: {unsupported}")
   return _flash_attention_pallas(q, k, v, causal, scale)

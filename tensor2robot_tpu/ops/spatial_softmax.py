@@ -10,9 +10,10 @@ softmax and the two weighted reductions; this kernel keeps one
 reductions in a single pass, so HBM traffic drops from ~4 passes over
 the activation to one read + one (B, 2, C) write.
 
-Gradient: custom_vjp whose backward recomputes through the XLA
-reference — the op is at the tower's narrow waist ((B, 2C) output), so
-the recompute is cheap relative to the conv tower around it.
+Gradient: custom_jvp whose rule routes through the XLA reference, so
+reverse-mode — including the higher-order reverse MAML's second-order
+outer gradient needs — derives from plain jnp ops; the kernel serves
+every non-differentiated forward (serving, eval, CEM sweeps).
 """
 
 from __future__ import annotations
@@ -23,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from tensor2robot_tpu.ops import dispatch
 
 # One (H·W, C_TILE) fp32 block must fit comfortably in VMEM (~16 MB).
 _MAX_VMEM_BLOCK_ELEMS = 1 << 21  # 2M fp32 elems = 8 MB
@@ -65,8 +68,10 @@ def _kernel(x_ref, out_ref, *, height: int, width: int,
   out_ref[0, 1, :] = jnp.sum(weights * y_coord, axis=0) * inv_denom[0]
 
 
-def _pallas_forward(features: jnp.ndarray,
-                    temperature: float) -> jnp.ndarray:
+def _pallas_forward(features: jnp.ndarray, temperature: float,
+                    interpret: bool = None) -> jnp.ndarray:
+  if interpret is None:
+    interpret = jax.default_backend() != "tpu"
   b, h, w, c = features.shape
   hw = h * w
   c_tile = min(c, _LANES)
@@ -81,31 +86,29 @@ def _pallas_forward(features: jnp.ndarray,
                              memory_space=pltpu.VMEM)],
       out_specs=pl.BlockSpec((1, 2, c_tile), lambda i, j: (i, 0, j),
                              memory_space=pltpu.VMEM),
-      interpret=jax.default_backend() != "tpu",
+      interpret=interpret,
   )(x)
   return jnp.concatenate([out[:, 0, :], out[:, 1, :]],
                          axis=-1).astype(features.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+@functools.partial(jax.custom_jvp, nondiff_argnums=(1,))
 def _spatial_softmax_pallas(features: jnp.ndarray,
                             temperature: float) -> jnp.ndarray:
   return _pallas_forward(features, temperature)
 
 
-def _fwd(features, temperature):
-  return _pallas_forward(features, temperature), features
-
-
-def _bwd(temperature, features, grad):
-  # Recompute through the XLA reference: the fused forward never
-  # materializes the attention weights the gradient needs.
-  _, vjp = jax.vjp(
-      lambda f: spatial_softmax_reference(f, temperature), features)
-  return vjp(grad)
-
-
-_spatial_softmax_pallas.defvjp(_fwd, _bwd)
+@_spatial_softmax_pallas.defjvp
+def _jvp(temperature, primals, tangents):
+  # Differentiation routes through the XLA reference (the fused forward
+  # never materializes the attention weights the chain rule needs).
+  # custom_jvp rather than custom_vjp: the rule below is plain jnp, so
+  # reverse-mode — and higher-order reverse, which MAML's second-order
+  # outer gradient needs — both derive from it. The Pallas kernel then
+  # serves every non-differentiated forward (serving, eval, CEM sweeps).
+  (features,), (features_dot,) = primals, tangents
+  return jax.jvp(lambda f: spatial_softmax_reference(f, temperature),
+                 (features,), (features_dot,))
 
 
 def _supported(features: jnp.ndarray) -> bool:
@@ -127,8 +130,18 @@ def spatial_softmax(features: jnp.ndarray, temperature: float = 1.0,
     (B, 2*C): per-channel expected coordinates in [-1, 1], x block
     then y block — same contract as the reference's spatial softmax.
   """
+  if implementation not in ("auto", "pallas", "xla"):
+    raise ValueError(
+        f"implementation must be 'auto', 'pallas', or 'xla'; got "
+        f"{implementation!r}")
   if implementation == "xla":
     return spatial_softmax_reference(features, temperature)
-  if implementation == "pallas" or _supported(features):
+  if implementation == "pallas":
+    # Explicit request: kernel on every platform (interpreted off-TPU) —
+    # the path CPU CI uses to exercise the kernel body.
     return _spatial_softmax_pallas(features, temperature)
-  return spatial_softmax_reference(features, temperature)
+  if dispatch.use_xla_only() or not _supported(features):
+    # xla_only: multi-platform export tracing (see ops/dispatch.py) —
+    # a compiled pallas_call cannot lower for the artifact's CPU target.
+    return spatial_softmax_reference(features, temperature)
+  return _spatial_softmax_pallas(features, temperature)

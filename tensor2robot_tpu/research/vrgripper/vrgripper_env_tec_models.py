@@ -1,0 +1,208 @@
+"""VRGripper task-embedded control (TEC) models.
+
+Reference parity: the reference's vrgripper TEC/meta variants
+(research/vrgripper — SURVEY.md §2 "VRGripper research": "task-embedded
+control / meta-BC variants"). Unlike the MAML variant
+(vrgripper_env_models.vrgripper_maml_model), TEC adapts with ZERO
+gradient steps at test time: a task-embedding network turns the
+condition (demonstration) episodes into one embedding vector, and the
+control network is FiLM-conditioned on that embedding — new task =
+new demo = new embedding, no optimizer on the robot.
+
+Input layout matches meta_learning/maml_model.py (task-batched
+condition/inference splits) so the same meta batches feed both
+families:
+    condition/features/image         (B, N_c, H, W, 3)
+    inference/features/image         (B, N_q, H, W, 3)
+    inference/features/gripper_pose  (B, N_q, P)
+    inference/labels/action          (B, N_q, A)   [TRAIN/EVAL only]
+
+Loss = query BC (MSE) + an embedding-alignment auxiliary: embeddings of
+the condition and inference episodes of the same task are pulled
+together (cosine), the TEC-style metric objective in its simplest form.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.layers.resnet import ResNet
+from tensor2robot_tpu.layers.vision_layers import ImagesToFeatures
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, Metrics
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_models import (
+    ACTION_SIZE,
+    GRIPPER_POSE_SIZE,
+    IMAGE_SIZE,
+)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class _TaskEmbeddingModule(nn.Module):
+  """Demo episodes → one L2-normalized task embedding.
+
+  (B·N, H, W, 3) images through a small conv tower, mean-pooled over
+  space and samples, projected to `embedding_size`.
+  """
+
+  embedding_size: int = 32
+  compute_dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, images: jnp.ndarray, num_samples: int,
+               train: bool = False) -> jnp.ndarray:
+    feature_map = ImagesToFeatures(
+        filters=(16, 32, 32), strides=(2, 2, 2),
+        dtype=self.compute_dtype, name="tower")(images, train=train)
+    pooled = jnp.mean(feature_map, axis=(1, 2)).astype(jnp.float32)
+    pooled = pooled.reshape(-1, num_samples, pooled.shape[-1])
+    episode = jnp.mean(pooled, axis=1)          # (B, F)
+    emb = nn.Dense(self.embedding_size, dtype=jnp.float32,
+                   name="project")(nn.relu(episode))
+    return emb / (jnp.linalg.norm(emb, axis=-1, keepdims=True) + 1e-8)
+
+
+class _TECControlModule(nn.Module):
+  """FiLM ResNet conditioned on (task embedding, proprioception)."""
+
+  action_size: int = ACTION_SIZE
+  compute_dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, images, gripper_pose, task_embedding,
+               train: bool = False) -> jnp.ndarray:
+    proprio = nn.relu(nn.Dense(32, dtype=self.compute_dtype,
+                               name="context_fc")(
+                                   gripper_pose.astype(self.compute_dtype)))
+    context = jnp.concatenate(
+        [task_embedding.astype(self.compute_dtype), proprio], axis=-1)
+    tower = ResNet(depth=18, width=32, film=True,
+                   dtype=self.compute_dtype, name="tower")
+    image_features = tower(images, context=context, train=train)
+    x = jnp.concatenate(
+        [image_features.astype(jnp.float32),
+         gripper_pose.astype(jnp.float32),
+         task_embedding.astype(jnp.float32)], axis=-1)
+    x = nn.relu(nn.Dense(128, dtype=jnp.float32, name="fc1")(x))
+    return nn.Dense(self.action_size, dtype=jnp.float32, name="action")(x)
+
+
+class _TECModule(nn.Module):
+  """Embedding + control wired over the meta batch layout."""
+
+  action_size: int
+  embedding_size: int
+  compute_dtype: Any
+
+  @nn.compact
+  def __call__(self, features, mode: str):
+    train = mode == modes.TRAIN
+    embed = _TaskEmbeddingModule(
+        embedding_size=self.embedding_size,
+        compute_dtype=self.compute_dtype, name="embedding")
+    control = _TECControlModule(
+        action_size=self.action_size,
+        compute_dtype=self.compute_dtype, name="control")
+
+    cond_images = features["condition/features/image"]
+    b, n_c = cond_images.shape[:2]
+    task_emb = embed(cond_images.reshape((b * n_c,) + cond_images.shape[2:]),
+                     num_samples=n_c, train=train)          # (B, E)
+
+    query_images = features["inference/features/image"]
+    query_pose = features["inference/features/gripper_pose"]
+    n_q = query_images.shape[1]
+    flat = lambda x: x.reshape((b * n_q,) + x.shape[2:])
+    emb_per_query = jnp.repeat(task_emb, n_q, axis=0)       # (B·N_q, E)
+    actions = control(flat(query_images), flat(query_pose),
+                      emb_per_query, train=train)
+    outputs = ts.TensorSpecStruct({
+        "inference_output": actions.reshape(b, n_q, self.action_size),
+        "task_embedding": task_emb,
+    })
+    if train:
+      # Inference-episode embedding for the alignment loss (train only:
+      # serving never needs it).
+      query_emb = embed(flat(query_images), num_samples=n_q, train=train)
+      outputs["query_embedding"] = query_emb
+    return outputs
+
+
+@configurable
+class VRGripperEnvTecModel(AbstractT2RModel):
+  """Zero-shot-adaptation BC via task embeddings (TEC)."""
+
+  def __init__(
+      self,
+      image_size: int = IMAGE_SIZE,
+      action_size: int = ACTION_SIZE,
+      gripper_pose_size: int = GRIPPER_POSE_SIZE,
+      embedding_size: int = 32,
+      num_condition_samples: int = 2,
+      num_inference_samples: int = 2,
+      embedding_loss_weight: float = 0.1,
+      **kwargs,
+  ):
+    super().__init__(**kwargs)
+    self._image_size = image_size
+    self._action_size = action_size
+    self._gripper_pose_size = gripper_pose_size
+    self._embedding_size = embedding_size
+    self.num_condition_samples = num_condition_samples
+    self.num_inference_samples = num_inference_samples
+    self._embedding_loss_weight = embedding_loss_weight
+
+  def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
+    out = ts.TensorSpecStruct()
+    # Condition episodes feed only the embedding net (images); the
+    # control net consumes query images + proprioception. Ground-truth
+    # query actions are a TRAIN/EVAL input only — a serving request
+    # must not have to fabricate them.
+    out["condition/features/image"] = ts.ExtendedTensorSpec(
+        (self.num_condition_samples, self._image_size, self._image_size,
+         3), np.float32)
+    out["inference/features/image"] = ts.ExtendedTensorSpec(
+        (self.num_inference_samples, self._image_size, self._image_size,
+         3), np.float32)
+    out["inference/features/gripper_pose"] = ts.ExtendedTensorSpec(
+        (self.num_inference_samples, self._gripper_pose_size), np.float32)
+    if mode != modes.PREDICT:
+      out["inference/labels/action"] = ts.ExtendedTensorSpec(
+          (self.num_inference_samples, self._action_size), np.float32)
+    return out
+
+  def get_label_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return ts.TensorSpecStruct()  # query labels travel inside features
+
+  def build_module(self) -> nn.Module:
+    return _TECModule(
+        action_size=self._action_size,
+        embedding_size=self._embedding_size,
+        compute_dtype=self.compute_dtype)
+
+  def loss_fn(self, outputs, features, labels) -> Tuple[jnp.ndarray, Metrics]:
+    del labels
+    target = features["inference/labels/action"].astype(jnp.float32)
+    bc_loss = jnp.mean(jnp.square(
+        outputs["inference_output"].astype(jnp.float32) - target))
+    metrics: Dict[str, jnp.ndarray] = {
+        "bc_mse": bc_loss,
+        "mean_action_error": jnp.mean(jnp.linalg.norm(
+            outputs["inference_output"].astype(jnp.float32) - target,
+            axis=-1)),
+    }
+    loss = bc_loss
+    if "query_embedding" in outputs:
+      alignment = jnp.mean(jnp.sum(
+          outputs["task_embedding"] * outputs["query_embedding"], axis=-1))
+      embedding_loss = 1.0 - alignment
+      loss = loss + self._embedding_loss_weight * embedding_loss
+      metrics["embedding_alignment"] = alignment
+    metrics["loss"] = loss
+    return loss, metrics

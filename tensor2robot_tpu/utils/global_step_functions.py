@@ -1,0 +1,78 @@
+"""Step-dependent value schedules.
+
+Reference parity: utils/global_step_functions.py (SURVEY.md §2 "Misc
+utils") — functions of the global step used for LR and loss-weight
+schedules. Here they are optax-style schedules: `fn(step) -> value`,
+jit-traceable (pure jnp, no Python branching on the step), so they
+drop directly into optax optimizers or loss code.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from tensor2robot_tpu.config import configurable
+
+
+@configurable
+def piecewise_linear(boundaries: Sequence[int],
+                     values: Sequence[float]):
+  """Linear interpolation through (boundary, value) control points.
+
+  Reference §piecewise_linear: before the first boundary the value is
+  values[0]; after the last it stays at values[-1]; in between the
+  value is linearly interpolated. Returns fn(step) -> float32 scalar.
+  """
+  if len(boundaries) != len(values):
+    raise ValueError(
+        f"Need one value per boundary; got {len(boundaries)} boundaries "
+        f"and {len(values)} values.")
+  if len(boundaries) < 1:
+    raise ValueError("Need at least one (boundary, value) control point.")
+  if list(boundaries) != sorted(boundaries):
+    raise ValueError(f"Boundaries must be ascending: {boundaries}")
+  bounds = jnp.asarray(boundaries, jnp.float32)
+  vals = jnp.asarray(values, jnp.float32)
+
+  def schedule(step) -> jnp.ndarray:
+    return jnp.interp(jnp.asarray(step, jnp.float32), bounds, vals)
+
+  return schedule
+
+
+@configurable
+def piecewise_constant(boundaries: Sequence[int],
+                       values: Sequence[float]):
+  """Step function: values[i] while step < boundaries[i], else values[-1].
+
+  Needs len(values) == len(boundaries) + 1.
+  """
+  if len(values) != len(boundaries) + 1:
+    raise ValueError(
+        f"Need len(values) == len(boundaries) + 1; got {len(values)} "
+        f"values for {len(boundaries)} boundaries.")
+  if list(boundaries) != sorted(boundaries):
+    raise ValueError(f"Boundaries must be ascending: {boundaries}")
+  bounds = jnp.asarray(boundaries, jnp.float32)
+  vals = jnp.asarray(values, jnp.float32)
+
+  def schedule(step) -> jnp.ndarray:
+    index = jnp.sum(jnp.asarray(step, jnp.float32) >= bounds)
+    return vals[index]
+
+  return schedule
+
+
+@configurable
+def exponential_decay(initial_value: float, decay_steps: int,
+                      decay_rate: float, staircase: bool = False):
+  """initial_value * decay_rate ** (step / decay_steps)."""
+  def schedule(step) -> jnp.ndarray:
+    exponent = jnp.asarray(step, jnp.float32) / decay_steps
+    if staircase:
+      exponent = jnp.floor(exponent)
+    return initial_value * decay_rate ** exponent
+
+  return schedule

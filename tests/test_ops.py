@@ -72,6 +72,20 @@ class TestSpatialSoftmax:
     np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_ref),
                                atol=1e-5)
 
+  def test_second_order_gradients(self):
+    # MAML differentiates the tower twice; the custom_jvp rule must
+    # support grad-of-grad (regression: custom_vjp broke this).
+    x = jnp.asarray(
+        np.random.default_rng(5).standard_normal((1, 4, 4, 2)),
+        jnp.float32)
+    f_p = lambda x: jnp.sum(spatial_softmax(x,
+                                            implementation="pallas") ** 3)
+    f_r = lambda x: jnp.sum(spatial_softmax_reference(x) ** 3)
+    gg_p = jax.grad(lambda x: jnp.sum(jax.grad(f_p)(x) ** 2))(x)
+    gg_r = jax.grad(lambda x: jnp.sum(jax.grad(f_r)(x) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(gg_p), np.asarray(gg_r),
+                               atol=1e-4)
+
   def test_jit_and_vision_layer_use(self):
     from tensor2robot_tpu.layers.vision_layers import (
         spatial_softmax as layer_op,
@@ -146,3 +160,49 @@ class TestFlashAttention:
                                 implementation="pallas")
     np.testing.assert_allclose(np.asarray(out_flash),
                                np.asarray(out_ring), atol=2e-5)
+
+
+class TestDispatch:
+
+  def test_xla_only_context(self):
+    from tensor2robot_tpu.ops import dispatch
+    assert not dispatch.use_xla_only()
+    with dispatch.xla_only():
+      assert dispatch.use_xla_only()
+      with dispatch.xla_only():
+        assert dispatch.use_xla_only()
+      assert dispatch.use_xla_only()  # nesting restores, not clears
+    assert not dispatch.use_xla_only()
+
+  def test_multi_platform_export_of_auto_op(self):
+    # Regression: a model whose tower uses the auto spatial softmax must
+    # export for platforms=("cpu","tpu") — compiled pallas_calls cannot
+    # lower for CPU, so xla_only() must reroute the trace.
+    import jax
+    from tensor2robot_tpu.ops import dispatch, spatial_softmax
+    x_spec = jax.ShapeDtypeStruct((2, 8, 8, 4), jnp.float32)
+    with dispatch.xla_only():
+      exported = jax.export.export(
+          jax.jit(lambda x: spatial_softmax(x)),
+          platforms=("cpu", "tpu"))(x_spec)
+    back = jax.export.deserialize(bytearray(exported.serialize()))
+    out = jax.jit(back.call)(np.ones((2, 8, 8, 4), np.float32))
+    assert out.shape == (2, 8)
+
+  def test_invalid_implementation_raises(self):
+    x = jnp.zeros((1, 4, 4, 2), jnp.float32)
+    with pytest.raises(ValueError, match="implementation"):
+      spatial_softmax(x, implementation="XLA")
+    q = jnp.zeros((1, 16, 1, 8), jnp.float32)
+    with pytest.raises(ValueError, match="implementation"):
+      flash_attention(q, q, q, implementation="Pallas")
+
+  def test_flash_attention_vmem_guard(self):
+    # Huge T that is 128-divisible must fall back in auto mode and
+    # raise (not compile-crash) when pallas is forced.
+    t = 1 << 16
+    big = jnp.zeros((1, t, 1, 64), jnp.bfloat16)
+    from tensor2robot_tpu.ops.flash_attention import _supported
+    assert _supported(big, big) is not None  # exceeds VMEM budget
+    with pytest.raises(ValueError, match="VMEM"):
+      flash_attention(big, big, big, implementation="pallas")

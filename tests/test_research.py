@@ -174,6 +174,8 @@ class TestResearchConfigs:
        "tensor2robot_tpu.research.grasp2vec.grasp2vec_model"),
       ("tensor2robot_tpu/research/vrgripper/configs/vrgripper_train.cfg",
        "tensor2robot_tpu.research.vrgripper.vrgripper_env_models"),
+      ("tensor2robot_tpu/research/vrgripper/configs/vrgripper_tec_train.cfg",
+       "tensor2robot_tpu.research.vrgripper.vrgripper_env_tec_models"),
   ]
 
   def test_reference_style_maml_name(self):

@@ -91,6 +91,31 @@ class TestVRGripper:
         image_size=32, num_condition_samples=2, num_inference_samples=2)
     T2RModelFixture().random_train(model, max_train_steps=1, batch_size=8)
 
+  def test_tec_model_trains_and_predicts(self):
+    from tensor2robot_tpu.research.vrgripper.vrgripper_env_tec_models import (
+        VRGripperEnvTecModel,
+    )
+    model = VRGripperEnvTecModel(
+        image_size=32, embedding_size=8,
+        num_condition_samples=2, num_inference_samples=2,
+        compute_dtype=jnp.float32,
+        optimizer_fn=lambda: optax.adam(1e-3))
+    result = T2RModelFixture().random_train(model, max_train_steps=2,
+                                            batch_size=8)
+    assert "embedding_alignment" in result.train_metrics
+    # PREDICT: no query_embedding output, actions shaped (B, N_q, A).
+    variables = model.init_variables(jax.random.key(0), batch_size=2)
+    spec = model.get_feature_specification(modes.PREDICT)
+    features = jax.tree_util.tree_map(
+        jnp.asarray, ts.make_random_batch(spec, batch_size=2))
+    outputs = model.predict_fn(variables, features)
+    assert outputs["inference_output"].shape == (2, 2, 7)
+    assert outputs["task_embedding"].shape == (2, 8)
+    assert "query_embedding" not in outputs
+    # Embeddings are L2-normalized.
+    norms = np.linalg.norm(np.asarray(outputs["task_embedding"]), axis=-1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-4)
+
   def test_mdn_predict_is_mode(self):
     model = VRGripperEnvModel(image_size=32, num_mixture_components=3)
     variables = model.init_variables(jax.random.key(0))

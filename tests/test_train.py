@@ -226,3 +226,51 @@ class TestCheckpoints:
     merged = merge_params(target, restored)
     np.testing.assert_array_equal(np.asarray(merged["a"]), np.ones((2,)))
     np.testing.assert_array_equal(np.asarray(merged["b"]), np.zeros((3,)))
+
+
+class TestGlobalStepFunctions:
+
+  def test_piecewise_linear(self):
+    import jax
+    import numpy as np
+    from tensor2robot_tpu.utils.global_step_functions import (
+        piecewise_linear,
+    )
+    fn = piecewise_linear([10, 20, 40], [1.0, 0.5, 0.1])
+    assert float(fn(0)) == 1.0          # before first boundary
+    assert float(fn(10)) == 1.0
+    np.testing.assert_allclose(float(fn(15)), 0.75)   # midpoint
+    np.testing.assert_allclose(float(fn(30)), 0.3)
+    assert abs(float(fn(100)) - 0.1) < 1e-7  # clamps after last
+    assert float(jax.jit(fn)(15)) == float(fn(15))    # jit-traceable
+    import pytest
+    with pytest.raises(ValueError, match="ascending"):
+      piecewise_linear([20, 10], [1.0, 0.5])
+
+  def test_piecewise_constant(self):
+    from tensor2robot_tpu.utils.global_step_functions import (
+        piecewise_constant,
+    )
+    import numpy as np
+    fn = piecewise_constant([100, 200], [1e-3, 1e-4, 1e-5])
+    np.testing.assert_allclose(float(fn(0)), 1e-3, rtol=1e-6)
+    np.testing.assert_allclose(float(fn(99)), 1e-3, rtol=1e-6)
+    assert abs(float(fn(100)) - 1e-4) < 1e-10
+    assert abs(float(fn(250)) - 1e-5) < 1e-10
+
+  def test_exponential_decay_and_optax_use(self):
+    import numpy as np
+    import optax
+    from tensor2robot_tpu.utils.global_step_functions import (
+        exponential_decay,
+    )
+    fn = exponential_decay(1.0, 100, 0.5)
+    np.testing.assert_allclose(float(fn(100)), 0.5)
+    np.testing.assert_allclose(float(fn(200)), 0.25)
+    stair = exponential_decay(1.0, 100, 0.5, staircase=True)
+    np.testing.assert_allclose(float(stair(150)), 0.5)
+    # Drops into optax as a schedule.
+    opt = optax.sgd(fn)
+    params = {"w": np.ones(2, np.float32)}
+    state = opt.init(params)
+    _ = opt.update({"w": np.ones(2, np.float32)}, state, params)
