@@ -20,8 +20,10 @@ from tensor2robot_tpu.export.savedmodel_export_generator import (
     SavedModelExportGenerator,
 )
 from tensor2robot_tpu.hooks.async_export_hook import AsyncExportHookBuilder
+from tensor2robot_tpu.utils import global_step_functions  # noqa: F401
 from tensor2robot_tpu.utils import optimizers  # noqa: F401 (registers)
 from tensor2robot_tpu.utils.mocks import MockT2RModel
+from tensor2robot_tpu.utils.profiling import ProfilerHookBuilder
 
 for _cls in (
     DefaultRandomInputGenerator,
@@ -32,5 +34,6 @@ for _cls in (
     SavedModelExportGenerator,
     AsyncExportHookBuilder,
     MockT2RModel,
+    ProfilerHookBuilder,
 ):
   configurable(_cls)

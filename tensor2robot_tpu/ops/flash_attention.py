@@ -190,7 +190,8 @@ def flash_attention(q, k, v, causal: bool = False,
   unsupported = _supported(q, k)
   if implementation == "xla" or (implementation == "auto"
                                  and (unsupported is not None
-                                      or dispatch.use_xla_only())):
+                                      or dispatch.use_xla_only()
+                                      or jax.default_backend() != "tpu")):
     return flash_attention_reference(q, k, v, causal, scale)
   if unsupported is not None:
     raise ValueError(f"flash_attention pallas path: {unsupported}")

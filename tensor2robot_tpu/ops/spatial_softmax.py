@@ -68,10 +68,9 @@ def _kernel(x_ref, out_ref, *, height: int, width: int,
   out_ref[0, 1, :] = jnp.sum(weights * y_coord, axis=0) * inv_denom[0]
 
 
-def _pallas_forward(features: jnp.ndarray, temperature: float,
-                    interpret: bool = None) -> jnp.ndarray:
-  if interpret is None:
-    interpret = jax.default_backend() != "tpu"
+def _pallas_forward(features: jnp.ndarray,
+                    temperature: float) -> jnp.ndarray:
+  interpret = jax.default_backend() != "tpu"
   b, h, w, c = features.shape
   hw = h * w
   c_tile = min(c, _LANES)
@@ -140,8 +139,12 @@ def spatial_softmax(features: jnp.ndarray, temperature: float = 1.0,
     # Explicit request: kernel on every platform (interpreted off-TPU) —
     # the path CPU CI uses to exercise the kernel body.
     return _spatial_softmax_pallas(features, temperature)
-  if dispatch.use_xla_only() or not _supported(features):
-    # xla_only: multi-platform export tracing (see ops/dispatch.py) —
-    # a compiled pallas_call cannot lower for the artifact's CPU target.
+  if (dispatch.use_xla_only() or jax.default_backend() != "tpu"
+      or not _supported(features)):
+    # xla_only: multi-platform export tracing (see ops/dispatch.py) — a
+    # compiled pallas_call cannot lower for the artifact's CPU target.
+    # Off-TPU, auto means XLA: an interpreted kernel is strictly slower
+    # there (explicit implementation="pallas" remains the CI coverage
+    # path).
     return spatial_softmax_reference(features, temperature)
   return _spatial_softmax_pallas(features, temperature)

@@ -1,0 +1,127 @@
+"""Multi-host runtime: process bootstrap + hybrid ICI/DCN meshes.
+
+Reference parity: the reference's multi-device story was TPUEstimator's
+master RPC + per-host infeed (upstream) and NCCL MirroredStrategy (the
+fork) — SURVEY.md §5.8. The JAX-native equivalent has two halves:
+
+1. Process bootstrap: every host calls `initialize()` once, then the
+   normal single-program code sees the GLOBAL device set
+   (`jax.devices()`), and the existing mesh/pjit path scales to
+   multi-host unchanged — XLA routes collectives over ICI within a
+   slice and DCN across slices.
+2. Mesh layout: `create_hybrid_mesh` keeps bandwidth-hungry axes
+   (model/tensor parallel) inside a slice (ICI) and puts the
+   gradient-all-reduce data axis across slices (DCN), the standard
+   layout from the scaling playbook.
+
+Nothing here opens sockets itself; `jax.distributed.initialize` speaks
+the JAX coordination service (or the TPU metadata autodetect path), so
+there is no NCCL/MPI dependency to replace.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Mapping, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+_log = logging.getLogger(__name__)
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+  """Connects this process to the multi-host runtime (idempotent).
+
+  With no arguments, relies on the environment autodetection
+  (TPU pod metadata / cluster env vars) exactly like bare
+  `jax.distributed.initialize`. Single-process runs may skip calling
+  this entirely; calling it twice is a no-op.
+  """
+  global _initialized
+  if _initialized:
+    return
+  if (coordinator_address is None and num_processes is None
+      and process_id is None and jax.process_count() == 1):
+    # Either truly single-process or already initialized by the runtime.
+    _initialized = True
+    return
+  jax.distributed.initialize(
+      coordinator_address=coordinator_address,
+      num_processes=num_processes,
+      process_id=process_id)
+  _initialized = True
+  _log.info("Distributed runtime up: process %d/%d, %d local of %d "
+            "global devices.", jax.process_index(), jax.process_count(),
+            jax.local_device_count(), jax.device_count())
+
+
+def is_primary() -> bool:
+  """True on the process that owns logging/checkpoint/export side
+  effects (reference: the chief worker)."""
+  return jax.process_index() == 0
+
+
+def create_hybrid_mesh(
+    ici_axes: Mapping[str, int],
+    dcn_axes: Optional[Mapping[str, int]] = None,
+) -> Mesh:
+  """Mesh whose `ici_axes` stay within a slice and `dcn_axes` span slices.
+
+  Args:
+    ici_axes: ordered {axis: size} laid out over in-slice ICI links —
+      put model/tensor/sequence axes here.
+    dcn_axes: ordered {axis: size} laid out across slices over DCN —
+      typically just the gradient-all-reduce `data` axis. One size may
+      be -1 (fill). None/empty or single-slice topologies degrade to a
+      plain `create_mesh` over everything (DCN layout is irrelevant
+      when there is nothing to cross).
+
+  Returns:
+    jax.sharding.Mesh with dcn axes outermost, ici axes innermost.
+  """
+  dcn_axes = dict(dcn_axes or {})
+  axes = {**dcn_axes, **{k: v for k, v in ici_axes.items()}}
+  if len(set(axes)) != len(dcn_axes) + len(ici_axes):
+    raise ValueError(
+        f"Axis names repeat across ici {list(ici_axes)} and dcn "
+        f"{list(dcn_axes)}.")
+  devices = jax.devices()
+  num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+  if not dcn_axes or num_slices == 1:
+    return mesh_lib.create_mesh(axes)
+
+  from jax.experimental import mesh_utils
+  ici_sizes = list(ici_axes.values())
+  dcn_sizes = [v for v in dcn_axes.values()]
+  fill = [i for i, v in enumerate(dcn_sizes) if v == -1]
+  if len(fill) > 1:
+    raise ValueError("At most one dcn axis may be -1.")
+  if fill:
+    fixed = int(np.prod([v for v in dcn_sizes if v != -1]))
+    per_slice = int(np.prod(ici_sizes)) * fixed
+    if len(devices) % per_slice != 0:
+      raise ValueError(
+          f"{len(devices)} devices not divisible by {per_slice} "
+          f"(ici {ici_axes} × fixed dcn axes).")
+    dcn_sizes[fill[0]] = len(devices) // per_slice
+  # DCN axes lead: slice index is the slowest-varying device coordinate.
+  device_array = mesh_utils.create_hybrid_device_mesh(
+      mesh_shape=[1] * len(dcn_sizes) + ici_sizes,
+      dcn_mesh_shape=dcn_sizes + [1] * len(ici_sizes),
+      devices=devices)
+  return Mesh(device_array, tuple(dcn_axes) + tuple(ici_axes))
+
+
+def sync_global_devices(name: str) -> None:
+  """Cross-host barrier (reference: implicit session-run sync points)."""
+  from jax.experimental import multihost_utils
+  multihost_utils.sync_global_devices(name)

@@ -17,9 +17,9 @@ families:
     inference/features/gripper_pose  (B, N_q, P)
     inference/labels/action          (B, N_q, A)   [TRAIN/EVAL only]
 
-Loss = query BC (MSE) + an embedding-alignment auxiliary: embeddings of
-the condition and inference episodes of the same task are pulled
-together (cosine), the TEC-style metric objective in its simplest form.
+Loss = query BC (MSE) + a contrastive embedding auxiliary (n-pairs over
+the task batch: same-task condition/inference embeddings attract, other
+tasks in the meta-batch repel) — the TEC-style metric objective.
 """
 
 from __future__ import annotations
@@ -125,9 +125,10 @@ class _TECModule(nn.Module):
         "inference_output": actions.reshape(b, n_q, self.action_size),
         "task_embedding": task_emb,
     })
-    if train:
-      # Inference-episode embedding for the alignment loss (train only:
-      # serving never needs it).
+    if mode != modes.PREDICT:
+      # Inference-episode embedding for the contrastive embedding loss —
+      # computed in TRAIN and EVAL (eval must measure the same objective
+      # training optimizes); serving never needs it.
       query_emb = embed(flat(query_images), num_samples=n_q, train=train)
       outputs["query_embedding"] = query_emb
     return outputs
@@ -199,10 +200,19 @@ class VRGripperEnvTecModel(AbstractT2RModel):
     }
     loss = bc_loss
     if "query_embedding" in outputs:
-      alignment = jnp.mean(jnp.sum(
-          outputs["task_embedding"] * outputs["query_embedding"], axis=-1))
-      embedding_loss = 1.0 - alignment
+      # Contrastive (n-pairs over the task batch): condition and
+      # inference embeddings of the SAME task attract, other tasks in
+      # the meta-batch are negatives — a same-pair-only cosine term
+      # would be globally minimized by embedding collapse (all tasks →
+      # one vector), destroying the task discrimination FiLM relies on.
+      from tensor2robot_tpu.research.grasp2vec.losses import npairs_loss
+      embedding_loss, embedding_accuracy = npairs_loss(
+          outputs["task_embedding"], outputs["query_embedding"])
       loss = loss + self._embedding_loss_weight * embedding_loss
-      metrics["embedding_alignment"] = alignment
+      metrics["embedding_loss"] = embedding_loss
+      metrics["embedding_accuracy"] = embedding_accuracy
+      metrics["embedding_alignment"] = jnp.mean(jnp.sum(
+          outputs["task_embedding"] * outputs["query_embedding"],
+          axis=-1))
     metrics["loss"] = loss
     return loss, metrics

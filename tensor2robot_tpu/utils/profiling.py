@@ -1,0 +1,94 @@
+"""Profiling: TPU trace capture wired into the train loop.
+
+Reference parity: SURVEY.md §5.1 — the reference exposed nothing beyond
+tf.summary + external TPU profiler capture; the rebuild makes tracing a
+first-class, config-injectable hook. `ProfilerHookBuilder` captures a
+window of train steps with `jax.profiler` (XLA device traces + host
+annotations) into <model_dir>/profile, viewable in TensorBoard or
+Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import List, Optional
+
+import jax
+
+from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder
+
+_log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+  """Context manager capturing a jax.profiler trace into `log_dir`."""
+  jax.profiler.start_trace(log_dir)
+  try:
+    yield
+  finally:
+    jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+  """Named region visible in captured traces (host + device timeline)."""
+  return jax.profiler.TraceAnnotation(name)
+
+
+class ProfilerHook(Hook):
+  """Captures [start_step, end_step) of training into a trace dir.
+
+  Steps are counted at metric sync points (after_step), so the captured
+  window is aligned to host-visible step boundaries; the device trace
+  inside the window still shows every compiled step the device ran.
+  """
+
+  def __init__(self, start_step: int = 10, end_step: int = 13,
+               log_dir: Optional[str] = None):
+    if end_step <= start_step:
+      raise ValueError(
+          f"end_step ({end_step}) must be > start_step ({start_step}).")
+    self._start_step = start_step
+    self._end_step = end_step
+    self._log_dir = log_dir
+    self._tracing = False
+
+  def begin(self, trainer, state, model_dir: str) -> None:
+    if self._log_dir is None:
+      self._log_dir = os.path.join(model_dir or ".", "profile")
+
+  def after_step(self, state, metrics: dict) -> None:
+    step = int(state.step)
+    if not self._tracing and self._start_step <= step < self._end_step:
+      os.makedirs(self._log_dir, exist_ok=True)
+      jax.profiler.start_trace(self._log_dir)
+      self._tracing = True
+      _log.info("Profiler trace started at step %d → %s", step,
+                self._log_dir)
+    elif self._tracing and step >= self._end_step:
+      jax.profiler.stop_trace()
+      self._tracing = False
+      _log.info("Profiler trace stopped at step %d.", step)
+
+  def end(self, state) -> None:
+    if self._tracing:
+      jax.profiler.stop_trace()
+      self._tracing = False
+      _log.info("Profiler trace stopped at end of training.")
+
+
+class ProfilerHookBuilder(HookBuilder):
+  """Config-injectable profiler (SURVEY.md §5.1 rebuild note)."""
+
+  def __init__(self, start_step: int = 10, end_step: int = 13,
+               log_dir: Optional[str] = None):
+    self._start_step = start_step
+    self._end_step = end_step
+    self._log_dir = log_dir
+
+  def create_hooks(self, trainer, model_dir: str) -> List[Hook]:
+    return [ProfilerHook(start_step=self._start_step,
+                         end_step=self._end_step,
+                         log_dir=self._log_dir)]

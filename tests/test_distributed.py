@@ -1,0 +1,84 @@
+"""Tests for multi-host helpers and the profiler hook (single-process)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.parallel import distributed
+from tensor2robot_tpu.parallel.mesh import create_mesh
+
+
+class TestDistributed:
+
+  def test_initialize_idempotent_single_process(self):
+    distributed.initialize()   # no-op on one process
+    distributed.initialize()   # and safely repeatable
+    assert distributed.is_primary()
+
+  def test_hybrid_mesh_single_slice_falls_back(self):
+    # 8 virtual CPU devices are one "slice": dcn layout degenerates to a
+    # plain mesh with the same axis order (dcn outermost).
+    mesh = distributed.create_hybrid_mesh(
+        {"model": 2}, dcn_axes={"data": -1})
+    assert mesh.axis_names == ("data", "model")
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 4, "model": 2}
+
+  def test_hybrid_mesh_no_dcn(self):
+    mesh = distributed.create_hybrid_mesh({"data": -1})
+    assert mesh.axis_names == ("data",)
+    assert mesh.devices.size == jax.device_count()
+
+  def test_hybrid_mesh_rejects_duplicate_axes(self):
+    with pytest.raises(ValueError, match="repeat"):
+      distributed.create_hybrid_mesh({"data": 2}, dcn_axes={"data": 2})
+
+  def test_sync_global_devices_single_process(self):
+    distributed.sync_global_devices("test_barrier")  # trivially passes
+
+
+class TestProfilerHook:
+
+  def test_captures_trace_window(self, tmp_path):
+    import optax
+    from tensor2robot_tpu.data.default_input_generator import (
+        DefaultRandomInputGenerator,
+    )
+    from tensor2robot_tpu.train.train_eval import train_eval_model
+    from tensor2robot_tpu.utils.mocks import MockT2RModel
+    from tensor2robot_tpu.utils.profiling import ProfilerHookBuilder
+
+    model_dir = str(tmp_path / "run")
+    train_eval_model(
+        MockT2RModel(),
+        input_generator_train=DefaultRandomInputGenerator(
+            batch_size=8, seed=0),
+        max_train_steps=4,
+        model_dir=model_dir,
+        log_every_steps=1,
+        hook_builders=[ProfilerHookBuilder(start_step=1, end_step=3)],
+    )
+    profile_dir = os.path.join(model_dir, "profile")
+    assert os.path.isdir(profile_dir)
+    # jax writes plugins/profile/<run>/*.trace.json.gz (or .xplane.pb).
+    found = []
+    for root, _, files in os.walk(profile_dir):
+      found.extend(files)
+    assert found, "no trace files captured"
+
+  def test_rejects_empty_window(self):
+    from tensor2robot_tpu.utils.profiling import ProfilerHook
+    with pytest.raises(ValueError, match="must be >"):
+      ProfilerHook(start_step=5, end_step=5)
+
+  def test_annotate_and_trace_helpers(self, tmp_path):
+    from tensor2robot_tpu.utils import profiling
+    with profiling.trace(str(tmp_path)):
+      with profiling.annotate("test_region"):
+        jax.block_until_ready(jax.numpy.ones(8) * 2)
+    files = []
+    for root, _, fs in os.walk(str(tmp_path)):
+      files.extend(fs)
+    assert files
