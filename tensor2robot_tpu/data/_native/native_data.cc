@@ -13,8 +13,12 @@
 #include <cstdint>
 #include <cstring>
 
+#include <algorithm>
+#include <atomic>
 #include <csetjmp>
 #include <cstdio>
+#include <thread>
+#include <vector>
 
 extern "C" {
 #include <jpeglib.h>
@@ -173,6 +177,92 @@ int32_t t2r_jpeg_decode(const uint8_t* data, uint64_t len,
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
   return 0;
+}
+
+// Decodes n JPEGs concurrently into one contiguous (n, h, w, channels)
+// uint8 buffer. Every image must decode to exactly (h, w) — per-image
+// status codes: 0 ok, -1 decode error, -2 dimension mismatch,
+// -3 corrupt-but-recoverable data (libjpeg only warns on e.g.
+// truncated entropy data and pads with gray; training data should not
+// silently include such frames). Output slots are zeroed on any
+// failure: an abort or recovery may already have written partial rows.
+// Spawns min(num_threads, n)
+// worker threads (libjpeg decompress objects are per-call, so decodes
+// are independent); the caller holds no locks — from Python the ctypes
+// call runs with the GIL released, so one call decodes a whole batch in
+// parallel regardless of Python threading. Returns the failure count.
+int32_t t2r_jpeg_decode_batch(const uint8_t* const* datas,
+                              const uint64_t* lens, uint8_t* out,
+                              int32_t expected_h, int32_t expected_w,
+                              int32_t channels, int32_t n,
+                              int32_t num_threads, int32_t* statuses) {
+  if (n <= 0) return 0;
+  const size_t image_bytes = static_cast<size_t>(expected_h) *
+                             expected_w * channels;
+  std::atomic<int32_t> next{0};
+  std::atomic<int32_t> failures{0};
+
+  auto worker = [&]() {
+    for (;;) {
+      const int32_t i = next.fetch_add(1);
+      if (i >= n) return;
+      uint8_t* dst = out + image_bytes * i;
+      jpeg_decompress_struct cinfo;
+      T2rJpegError jerr;
+      cinfo.err = jpeg_std_error(&jerr.mgr);
+      jerr.mgr.error_exit = t2r_jpeg_error_exit;
+      if (setjmp(jerr.jump)) {
+        jpeg_destroy_decompress(&cinfo);
+        // A mid-stream abort may have written partial rows; the
+        // contract is "failed slot is zeroed".
+        std::memset(dst, 0, image_bytes);
+        statuses[i] = -1;
+        failures.fetch_add(1);
+        continue;
+      }
+      jpeg_create_decompress(&cinfo);
+      jpeg_mem_src(&cinfo, const_cast<uint8_t*>(datas[i]),
+                   static_cast<unsigned long>(lens[i]));
+      jpeg_read_header(&cinfo, TRUE);
+      if (static_cast<int32_t>(cinfo.image_height) != expected_h ||
+          static_cast<int32_t>(cinfo.image_width) != expected_w) {
+        jpeg_destroy_decompress(&cinfo);
+        statuses[i] = -2;
+        failures.fetch_add(1);
+        continue;
+      }
+      cinfo.out_color_space = (channels == 1) ? JCS_GRAYSCALE : JCS_RGB;
+      jpeg_start_decompress(&cinfo);
+      const size_t row_stride =
+          static_cast<size_t>(cinfo.output_width) * cinfo.output_components;
+      while (cinfo.output_scanline < cinfo.output_height) {
+        uint8_t* row = dst + row_stride * cinfo.output_scanline;
+        jpeg_read_scanlines(&cinfo, &row, 1);
+      }
+      jpeg_finish_decompress(&cinfo);
+      const bool corrupt = jerr.mgr.num_warnings > 0;
+      jpeg_destroy_decompress(&cinfo);
+      if (corrupt) {
+        std::memset(dst, 0, image_bytes);
+        statuses[i] = -3;
+        failures.fetch_add(1);
+        continue;
+      }
+      statuses[i] = 0;
+    }
+  };
+
+  const int32_t threads =
+      std::max(1, std::min(num_threads, n));
+  if (threads == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(threads);
+    for (int32_t t = 0; t < threads; ++t) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return failures.load();
 }
 
 }  // extern "C"

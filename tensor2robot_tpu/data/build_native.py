@@ -19,7 +19,7 @@ LIBRARY = os.path.join(_THIS_DIR, "_native", "libt2rnative.so")
 def build(verbose: bool = True) -> str:
   """Compiles the shared library; returns its path."""
   cmd = [
-      "g++", "-O3", "-march=native", "-shared", "-fPIC",
+      "g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
       SOURCE, "-o", LIBRARY, "-ljpeg",
   ]
   result = subprocess.run(cmd, capture_output=True, text=True)

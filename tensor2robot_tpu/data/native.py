@@ -44,6 +44,13 @@ class NativeData:
     lib.t2r_jpeg_decode.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+    if hasattr(lib, "t2r_jpeg_decode_batch"):  # older .so may predate it
+      lib.t2r_jpeg_decode_batch.restype = ctypes.c_int32
+      lib.t2r_jpeg_decode_batch.argtypes = [
+          ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+          ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
+          ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+          ctypes.POINTER(ctypes.c_int32)]
 
   def masked_crc32c(self, data: bytes) -> int:
     return self._lib.t2r_masked_crc32c(data, len(data))
@@ -91,6 +98,47 @@ class NativeData:
     if rc != 0:
       raise ValueError("JPEG decode failed")
     return out
+
+  @property
+  def has_batch_decode(self) -> bool:
+    return hasattr(self._lib, "t2r_jpeg_decode_batch")
+
+  def jpeg_decode_batch(
+      self,
+      images: "list[bytes]",
+      height: int,
+      width: int,
+      channels: int = 3,
+      num_threads: int = 0,
+  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Decodes a batch concurrently in C++ (GIL released for the whole
+    batch — one call saturates all cores regardless of Python threads).
+
+    Every image must decode to exactly (height, width); failures leave
+    their output slot zeroed.
+
+    Returns:
+      ((N, H, W, C) uint8 array, (N,) int32 statuses — 0 ok, -1 decode
+      error, -2 dimension mismatch, -3 corrupt-but-recoverable data
+      such as truncated entropy segments).
+    """
+    if channels not in (1, 3):
+      raise ValueError(f"channels must be 1 or 3, got {channels}")
+    n = len(images)
+    out = np.zeros((n, height, width, channels), np.uint8)
+    statuses = np.zeros((n,), np.int32)
+    if n == 0:
+      return out, statuses
+    datas = (ctypes.c_char_p * n)(*images)
+    lens = (ctypes.c_uint64 * n)(*(len(im) for im in images))
+    if num_threads <= 0:
+      num_threads = min(n, os.cpu_count() or 1)
+    self._lib.t2r_jpeg_decode_batch(
+        datas, lens,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        height, width, channels, n, num_threads,
+        statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out, statuses
 
 
 def get_native(auto_build: bool = True) -> Optional[NativeData]:

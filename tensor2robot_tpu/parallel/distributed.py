@@ -41,25 +41,41 @@ def initialize(
 ) -> None:
   """Connects this process to the multi-host runtime (idempotent).
 
-  With no arguments, relies on the environment autodetection
-  (TPU pod metadata / cluster env vars) exactly like bare
-  `jax.distributed.initialize`. Single-process runs may skip calling
-  this entirely; calling it twice is a no-op.
+  MUST run before any other JAX API touches the backend (device
+  queries included) — backend initialization is one-shot, and an
+  uncoordinated backend sees only local devices. With no arguments,
+  relies on `jax.distributed.initialize`'s cluster autodetection (TPU
+  pod metadata / cluster env vars); when no cluster environment is
+  detectable this degrades to a logged single-process no-op, so
+  single-process runs may call it unconditionally.
   """
   global _initialized
   if _initialized:
     return
-  if (coordinator_address is None and num_processes is None
-      and process_id is None and jax.process_count() == 1):
-    # Either truly single-process or already initialized by the runtime.
-    _initialized = True
-    return
-  jax.distributed.initialize(
-      coordinator_address=coordinator_address,
-      num_processes=num_processes,
-      process_id=process_id)
+  explicit = (coordinator_address is not None or num_processes is not None
+              or process_id is not None)
+  try:
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+  except (RuntimeError, ValueError) as e:
+    if explicit:
+      raise
+    # No detectable cluster environment (bare single-process run) — or
+    # the backend was already initialized, in which case multi-host
+    # setup either already happened (fine) or is impossible now (the
+    # caller violated the call-order contract; surface that loudly).
+    if "already" in str(e).lower():
+      _log.warning(
+          "jax.distributed.initialize skipped: backend already "
+          "initialized (%s). If this is a multi-host run, initialize() "
+          "must be the first JAX call in the process.", e)
+    else:
+      _log.info("No cluster environment detected (%s); single-process.",
+                e)
   _initialized = True
-  _log.info("Distributed runtime up: process %d/%d, %d local of %d "
+  _log.info("Distributed runtime: process %d/%d, %d local of %d "
             "global devices.", jax.process_index(), jax.process_count(),
             jax.local_device_count(), jax.device_count())
 
@@ -94,6 +110,11 @@ def create_hybrid_mesh(
     raise ValueError(
         f"Axis names repeat across ici {list(ici_axes)} and dcn "
         f"{list(dcn_axes)}.")
+  if dcn_axes and any(v == -1 for v in ici_axes.values()):
+    # A -1 ici axis would fill across slices, defeating the layout.
+    raise ValueError(
+        f"-1 (fill) is only allowed on dcn axes when dcn_axes is set; "
+        f"got ici_axes={dict(ici_axes)}.")
   devices = jax.devices()
   num_slices = len({getattr(d, "slice_index", 0) for d in devices})
   if not dcn_axes or num_slices == 1:

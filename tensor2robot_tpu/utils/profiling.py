@@ -10,7 +10,6 @@ Perfetto.
 
 from __future__ import annotations
 
-import contextlib
 import logging
 import os
 from typing import List, Optional
@@ -21,28 +20,22 @@ from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder
 
 _log = logging.getLogger(__name__)
 
-
-@contextlib.contextmanager
-def trace(log_dir: str):
-  """Context manager capturing a jax.profiler trace into `log_dir`."""
-  jax.profiler.start_trace(log_dir)
-  try:
-    yield
-  finally:
-    jax.profiler.stop_trace()
-
-
-def annotate(name: str):
-  """Named region visible in captured traces (host + device timeline)."""
-  return jax.profiler.TraceAnnotation(name)
+# Re-exported so consumers have one profiling import surface;
+# jax.profiler.trace is already a context manager with the exact
+# start/stop semantics a wrapper would reimplement.
+trace = jax.profiler.trace
+annotate = jax.profiler.TraceAnnotation
 
 
 class ProfilerHook(Hook):
-  """Captures [start_step, end_step) of training into a trace dir.
+  """Captures a window of training steps into a trace dir.
 
-  Steps are counted at metric sync points (after_step), so the captured
-  window is aligned to host-visible step boundaries; the device trace
-  inside the window still shows every compiled step the device ran.
+  Steps are observed at metric sync points (after_step — every
+  `log_every_steps`), so the realized window snaps outward to sync
+  boundaries: the trace starts at the first sync step >= start_step and
+  stops at the first sync step >= end_step. With log_every_steps=100
+  and (start=10, end=13), that means one 100-step window starting at
+  step 100 — align the window to log_every_steps for precision.
   """
 
   def __init__(self, start_step: int = 10, end_step: int = 13,
@@ -54,29 +47,42 @@ class ProfilerHook(Hook):
     self._end_step = end_step
     self._log_dir = log_dir
     self._tracing = False
+    self._done = False
 
   def begin(self, trainer, state, model_dir: str) -> None:
     if self._log_dir is None:
       self._log_dir = os.path.join(model_dir or ".", "profile")
 
   def after_step(self, state, metrics: dict) -> None:
+    if self._done:
+      return
     step = int(state.step)
-    if not self._tracing and self._start_step <= step < self._end_step:
+    if not self._tracing and step >= self._start_step:
       os.makedirs(self._log_dir, exist_ok=True)
       jax.profiler.start_trace(self._log_dir)
       self._tracing = True
       _log.info("Profiler trace started at step %d → %s", step,
                 self._log_dir)
-    elif self._tracing and step >= self._end_step:
+      # A single sync point at/past the whole window still captures
+      # one sync interval rather than silently skipping.
+      return
+    if self._tracing and step >= self._end_step:
       jax.profiler.stop_trace()
       self._tracing = False
+      self._done = True
       _log.info("Profiler trace stopped at step %d.", step)
 
   def end(self, state) -> None:
     if self._tracing:
       jax.profiler.stop_trace()
       self._tracing = False
+      self._done = True
       _log.info("Profiler trace stopped at end of training.")
+    elif not self._done:
+      _log.warning(
+          "ProfilerHook never started: no metric sync step reached "
+          "start_step=%d (training ran %d steps).", self._start_step,
+          int(state.step))
 
 
 class ProfilerHookBuilder(HookBuilder):

@@ -128,3 +128,77 @@ class TestNativeSpeed:
     # Require at least rough parity (CI noise-tolerant); typically the
     # native path is meaningfully faster because it skips PIL's plumbing.
     assert native_time < pil_time * 1.5, (native_time, pil_time)
+
+
+class TestBatchJpegDecode:
+
+  def _jpegs(self, n=8, size=32, seed=0):
+    import io
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    images, arrays = [], []
+    for _ in range(n):
+      arr = rng.integers(0, 255, (size, size, 3), np.uint8)
+      buf = io.BytesIO()
+      Image.fromarray(arr).save(buf, "JPEG", quality=95)
+      images.append(buf.getvalue())
+      arrays.append(arr)
+    return images, arrays
+
+  def test_batch_matches_single(self):
+    lib = native.get_native()
+    if lib is None or not lib.has_batch_decode:
+      pytest.skip("native library unavailable")
+    images, _ = self._jpegs(n=8)
+    out, statuses = lib.jpeg_decode_batch(images, 32, 32, 3)
+    assert (statuses == 0).all()
+    for i, image in enumerate(images):
+      np.testing.assert_array_equal(out[i], lib.jpeg_decode(image))
+
+  def test_per_image_failures_isolated(self):
+    lib = native.get_native()
+    if lib is None or not lib.has_batch_decode:
+      pytest.skip("native library unavailable")
+    images, _ = self._jpegs(n=3)
+    bad = [images[0], b"corrupt bytes", images[2]]
+    out, statuses = lib.jpeg_decode_batch(bad, 32, 32, 3)
+    assert statuses[0] == 0 and statuses[2] == 0
+    assert statuses[1] == -1
+    assert (out[1] == 0).all()  # failed slot left zeroed
+    np.testing.assert_array_equal(out[0], lib.jpeg_decode(images[0]))
+
+  def test_truncated_jpeg_slot_zeroed(self):
+    # Valid header + cut-off entropy data: libjpeg aborts mid-scanline
+    # after writing partial rows; the slot must still come back zeroed.
+    lib = native.get_native()
+    if lib is None or not lib.has_batch_decode:
+      pytest.skip("native library unavailable")
+    images, _ = self._jpegs(n=1, size=64)
+    truncated = images[0][: len(images[0]) // 2]
+    out, statuses = lib.jpeg_decode_batch([truncated], 64, 64, 3)
+    assert statuses[0] != 0
+    assert (out[0] == 0).all()
+
+  def test_dimension_mismatch_status(self):
+    lib = native.get_native()
+    if lib is None or not lib.has_batch_decode:
+      pytest.skip("native library unavailable")
+    images, _ = self._jpegs(n=2, size=32)
+    _, statuses = lib.jpeg_decode_batch(images, 64, 64, 3)
+    assert (statuses == -2).all()
+
+  def test_empty_batch(self):
+    lib = native.get_native()
+    if lib is None or not lib.has_batch_decode:
+      pytest.skip("native library unavailable")
+    out, statuses = lib.jpeg_decode_batch([], 32, 32, 3)
+    assert out.shape == (0, 32, 32, 3) and statuses.shape == (0,)
+
+  def test_grayscale_batch(self):
+    lib = native.get_native()
+    if lib is None or not lib.has_batch_decode:
+      pytest.skip("native library unavailable")
+    images, _ = self._jpegs(n=4)
+    out, statuses = lib.jpeg_decode_batch(images, 32, 32, channels=1)
+    assert (statuses == 0).all()
+    assert out.shape == (4, 32, 32, 1)
