@@ -21,7 +21,10 @@ import logging
 import sys
 
 from tensor2robot_tpu import config as t2r_config
-from tensor2robot_tpu.train.train_eval import train_eval_model
+from tensor2robot_tpu.train.train_eval import (
+    continuous_eval_model,
+    train_eval_model,
+)
 
 
 def main(argv=None) -> int:
@@ -36,6 +39,13 @@ def main(argv=None) -> int:
   parser.add_argument("--import_module", action="append", default=[],
                       help="Extra modules to import so their configurables "
                            "register (repeatable)")
+  parser.add_argument("--mode", choices=("train_and_eval",
+                                         "continuous_eval"),
+                      default="train_and_eval",
+                      help="train_and_eval runs train_eval_model; "
+                           "continuous_eval runs the separate-job "
+                           "evaluator polling model_dir's checkpoints "
+                           "(configure continuous_eval_model.* bindings)")
   args = parser.parse_args(argv)
 
   logging.basicConfig(
@@ -49,8 +59,16 @@ def main(argv=None) -> int:
 
   t2r_config.parse_config_files_and_bindings(args.config, args.binding)
   if args.model_dir:
-    t2r_config.bind("train_eval_model.model_dir", args.model_dir)
+    target = ("continuous_eval_model.model_dir"
+              if args.mode == "continuous_eval"
+              else "train_eval_model.model_dir")
+    t2r_config.bind(target, args.model_dir)
 
+  if args.mode == "continuous_eval":
+    results = continuous_eval_model()
+    logging.info("Evaluated %d checkpoints: %s", len(results),
+                 sorted(results))
+    return 0
   result = train_eval_model()
   logging.info("Final train metrics: %s", result.train_metrics)
   logging.info("Final eval metrics: %s", result.eval_metrics)

@@ -66,6 +66,13 @@ class CheckpointManager:
   def all_steps(self):
     return self._manager.all_steps()
 
+  def reload(self) -> None:
+    """Re-reads the directory: orbax caches the step list at init and
+    only updates it on this manager's own saves, so pollers watching a
+    directory another process writes (the continuous evaluator) must
+    reload before each poll."""
+    self._manager.reload()
+
   def wait(self) -> None:
     self._manager.wait_until_finished()
 

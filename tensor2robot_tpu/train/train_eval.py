@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -108,19 +109,8 @@ def train_eval_model(
   def run_eval(state: TrainState) -> Dict[str, float]:
     if input_generator_eval is None:
       return {}
-    input_generator_eval.set_specification_from_model(model, modes.EVAL)
-    eval_iter = prefetch_to_device(
-        input_generator_eval.create_dataset_fn(modes.EVAL)(),
-        sharding=trainer.batch_sharding, depth=prefetch_depth)
-    sums: Dict[str, float] = {}
-    count = 0
-    for _, batch in zip(range(eval_steps), eval_iter):
-      features, labels = batch
-      metrics = trainer.eval_step(state, features, labels)
-      for key, value in metrics.items():
-        sums[key] = sums.get(key, 0.0) + float(value)
-      count += 1
-    return {key: value / max(count, 1) for key, value in sums.items()}
+    return _evaluate(trainer, model, input_generator_eval, state,
+                     eval_steps, prefetch_depth)
 
   if input_generator_train is not None and max_train_steps > 0:
     input_generator_train.set_specification_from_model(model, modes.TRAIN)
@@ -203,3 +193,95 @@ def train_eval_model(
       eval_metrics=eval_metrics,
       model_dir=model_dir,
   )
+
+
+def _evaluate(trainer, model, input_generator_eval, state,
+              eval_steps: int, prefetch_depth: int) -> Dict[str, float]:
+  """Averages eval metrics over eval_steps batches (shared by the
+  interleaved eval arm and the continuous evaluator)."""
+  input_generator_eval.set_specification_from_model(model, modes.EVAL)
+  eval_iter = prefetch_to_device(
+      input_generator_eval.create_dataset_fn(modes.EVAL)(),
+      sharding=trainer.batch_sharding, depth=prefetch_depth)
+  sums: Dict[str, float] = {}
+  count = 0
+  for _, batch in zip(range(eval_steps), eval_iter):
+    features, labels = batch
+    metrics = trainer.eval_step(state, features, labels)
+    for key, value in metrics.items():
+      sums[key] = sums.get(key, 0.0) + float(value)
+    count += 1
+  return {key: value / max(count, 1) for key, value in sums.items()}
+
+
+@configurable
+def continuous_eval_model(
+    model,
+    input_generator_eval,
+    model_dir: str,
+    eval_steps: int = 10,
+    poll_interval_s: float = 10.0,
+    timeout_s: float = 3600.0,
+    stop_after_step: int = 0,
+    max_evaluations: int = 0,
+    mesh=None,
+    seed: int = 0,
+    prefetch_depth: int = 2,
+) -> Dict[int, Dict[str, float]]:
+  """Separate-job evaluator: evaluate every checkpoint as it lands.
+
+  Reference parity: the continuous-evaluation arm of SURVEY.md §3.2 — a
+  dedicated eval job polling the trainer's model_dir, evaluating each
+  new checkpoint (EMA-swapped via state.variables semantics baked into
+  eval_step) and writing `eval/*` metrics under <model_dir>/eval for
+  TensorBoard.
+
+  Stops when: no new checkpoint appears within `timeout_s`; a
+  checkpoint at step >= `stop_after_step` (if > 0) has been evaluated
+  (the trainer is done); or `max_evaluations` (if > 0) checkpoints have
+  been evaluated.
+
+  Returns {checkpoint_step: eval metrics} for every evaluated step.
+  """
+  trainer = Trainer(model, mesh=mesh, seed=seed)
+  template = trainer.create_train_state()
+  checkpoint_manager = CheckpointManager(
+      os.path.join(model_dir, "checkpoints"))
+  metric_writer = MetricWriter(os.path.join(model_dir, "eval"))
+  results: Dict[int, Dict[str, float]] = {}
+  stop = False
+  last_new_checkpoint = time.monotonic()
+  try:
+    while not stop:
+      # The trainer process writes the checkpoints; re-read the
+      # directory (orbax caches the step list otherwise).
+      checkpoint_manager.reload()
+      pending = sorted(step for step in checkpoint_manager.all_steps()
+                       if step not in results)
+      for step in pending:  # every checkpoint, oldest first — no holes
+        last_new_checkpoint = time.monotonic()
+        state = checkpoint_manager.restore(template, step=step)
+        metrics = _evaluate(trainer, model, input_generator_eval, state,
+                            eval_steps, prefetch_depth)
+        results[step] = metrics
+        metric_writer.write_scalars(
+            step, {f"eval/{k}": v for k, v in metrics.items()})
+        _log.info("continuous eval @ step %d: %s", step, metrics)
+        if stop_after_step and step >= stop_after_step:
+          stop = True
+          break
+        if max_evaluations and len(results) >= max_evaluations:
+          stop = True
+          break
+      if stop:
+        break
+      if not pending:
+        if time.monotonic() - last_new_checkpoint > timeout_s:
+          _log.info("continuous eval: no new checkpoint for %.0fs; "
+                    "stopping.", timeout_s)
+          break
+        time.sleep(poll_interval_s)
+  finally:
+    metric_writer.close()
+    checkpoint_manager.close()
+  return results

@@ -227,3 +227,79 @@ class TestCLI:
     operative = open(
         os.path.join(model_dir, "operative_config.txt")).read()
     assert "max_train_steps = 2" in operative
+
+
+class TestContinuousEval:
+
+  def test_evaluates_each_checkpoint_then_stops(self, tmp_path):
+    from tensor2robot_tpu.train.train_eval import continuous_eval_model
+    model_dir = str(tmp_path / "run")
+    # Produce a run with checkpoints at steps 2, 4 (+ final at 4).
+    train_eval_model(
+        MockT2RModel(),
+        input_generator_train=DefaultRandomInputGenerator(
+            batch_size=8, seed=0),
+        max_train_steps=4,
+        model_dir=model_dir,
+        save_checkpoints_steps=2,
+        log_every_steps=2,
+    )
+    results = continuous_eval_model(
+        MockT2RModel(),
+        input_generator_eval=DefaultRandomInputGenerator(
+            batch_size=8, seed=1),
+        model_dir=model_dir,
+        eval_steps=2,
+        poll_interval_s=0.1,
+        timeout_s=5.0,
+        stop_after_step=4,
+    )
+    assert sorted(results) == [2, 4]   # every checkpoint, no holes
+    assert "loss" in results[4] and "loss" in results[2]
+    # Metrics written under <model_dir>/eval for TensorBoard.
+    eval_dir = os.path.join(model_dir, "eval")
+    assert os.path.isfile(os.path.join(eval_dir, "metrics.jsonl"))
+    rows = [json.loads(line)
+            for line in open(os.path.join(eval_dir, "metrics.jsonl"))]
+    assert any("eval/loss" in r for r in rows)
+
+  def test_cli_continuous_eval_mode(self, tmp_path):
+    from tensor2robot_tpu.bin.run_t2r_trainer import main
+    model_dir = str(tmp_path / "run")
+    train_eval_model(
+        MockT2RModel(),
+        input_generator_train=DefaultRandomInputGenerator(
+            batch_size=8, seed=0),
+        max_train_steps=2,
+        model_dir=model_dir,
+        log_every_steps=1,
+    )
+    cfg = tmp_path / "eval.cfg"
+    cfg.write_text(
+        "continuous_eval_model.model = @MockT2RModel()\n"
+        "continuous_eval_model.input_generator_eval = "
+        "@DefaultRandomInputGenerator()\n"
+        "DefaultRandomInputGenerator.batch_size = 8\n"
+        "continuous_eval_model.eval_steps = 1\n"
+        "continuous_eval_model.poll_interval_s = 0.1\n"
+        "continuous_eval_model.timeout_s = 1.0\n"
+        "continuous_eval_model.stop_after_step = 2\n")
+    assert main(["--config", str(cfg), "--model_dir", model_dir,
+                 "--mode", "continuous_eval"]) == 0
+    assert os.path.isfile(
+        os.path.join(model_dir, "eval", "metrics.jsonl"))
+
+  def test_times_out_without_checkpoints(self, tmp_path):
+    from tensor2robot_tpu.train.train_eval import continuous_eval_model
+    model_dir = str(tmp_path / "empty")
+    os.makedirs(os.path.join(model_dir, "checkpoints"), exist_ok=True)
+    results = continuous_eval_model(
+        MockT2RModel(),
+        input_generator_eval=DefaultRandomInputGenerator(
+            batch_size=8, seed=1),
+        model_dir=model_dir,
+        eval_steps=1,
+        poll_interval_s=0.1,
+        timeout_s=0.5,
+    )
+    assert results == {}
