@@ -8,13 +8,12 @@ what the XLA reference below does). Used for long in-device sequences;
 ring_attention composes it across chips for sequences that exceed one
 device.
 
-Gradient: custom_vjp recomputing through the XLA reference, so training
-at long T should prefer ring_attention (whose accumulation is
-differentiated directly); this kernel's primary consumers are
-inference-time attention (serving, CEM sweeps) and moderate-T training.
-First-order only — custom_vjp does not compose with forward-over-
-reverse, so models differentiated twice (MAML inner loops) must pass
-implementation="xla".
+Gradient: custom_vjp with Pallas backward kernels (the standard flash
+backward — residuals are q, k, v, the output, and the per-row
+logsumexp; dq and dk/dv are recomputed blockwise in two passes), so
+training memory stays O(T) end to end. First-order only — custom_vjp
+does not compose with forward-over-reverse, so models differentiated
+twice (MAML inner loops) must pass implementation="xla".
 """
 
 from __future__ import annotations
@@ -54,9 +53,20 @@ def flash_attention_reference(q, k, v, causal: bool = False,
   return out.astype(q.dtype)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-            block_q: int, block_k: int, seq_len: int):
-  """One (block_q, D) query tile vs all K/V tiles of this (b·h) row."""
+def _causal_mask(s, qi, kj, block_q: int, block_k: int):
+  """Mask the (BQ, BK) score tile to the causal triangle with -inf."""
+  rows = qi * block_q + jax.lax.broadcasted_iota(
+      jnp.int32, (block_q, block_k), 0)
+  cols = kj * block_k + jax.lax.broadcasted_iota(
+      jnp.int32, (block_q, block_k), 1)
+  return jnp.where(rows >= cols, s, -jnp.inf)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+            causal: bool, block_q: int, block_k: int, seq_len: int):
+  """One (block_q, D) query tile vs all K/V tiles of this (b·h) row.
+
+  Also emits the per-row logsumexp (the flash-backward residual)."""
   q = q_ref[0].astype(jnp.float32) * scale                 # (BQ, D)
   qi = pl.program_id(1)
   head_dim = q.shape[-1]
@@ -69,11 +79,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
         q, k_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)                # (BQ, BK)
     if causal:
-      rows = qi * block_q + jax.lax.broadcasted_iota(
-          jnp.int32, (block_q, block_k), 0)
-      cols = kj * block_k + jax.lax.broadcasted_iota(
-          jnp.int32, (block_q, block_k), 1)
-      s = jnp.where(rows >= cols, s, -jnp.inf)
+      s = _causal_mask(s, qi, kj, block_q, block_k)
     m_blk = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_blk)
     safe_max = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
@@ -92,7 +98,14 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
   init = (jnp.full((block_q, 1), -jnp.inf, jnp.float32),
           jnp.zeros((block_q, 1), jnp.float32),
           jnp.zeros((block_q, head_dim), jnp.float32))
-  _, l, acc = jax.lax.fori_loop(0, num_k, body, init)
+  m, l, acc = jax.lax.fori_loop(0, num_k, body, init)
+  safe_m = jnp.where(jnp.isneginf(m), 0.0, m)
+  # Fully-masked rows (l == 0, only possible non-causally with explicit
+  # masks) get a large-negative finite lse via the 1e-37 clamp; the
+  # backward's exp(s - lse) is still 0 there because s is -inf. Shape
+  # (BQ, 1): the lse array carries a trailing unit dim so its blocks
+  # satisfy the TPU (8, 128) block-shape rule.
+  lse_ref[0] = safe_m + jnp.log(jnp.maximum(l, 1e-37))
   l = jnp.where(l == 0.0, 1.0, l)
   o_ref[0] = (acc / l).astype(o_ref.dtype)
 
@@ -119,31 +132,188 @@ def _supported(q, k) -> Optional[str]:
   return None
 
 
-def _pallas_forward(q, k, v, causal: bool, scale: float):
+def _to_rows(x):
+  b, t, h, d = x.shape
+  return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_rows(x, b, h):
+  bh, t, d = x.shape
+  return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _pallas_forward(q, k, v, causal: bool, scale: float,
+                    with_residuals: bool = False):
   b, t, h, d = q.shape
   block_q, block_k = _block_sizes(t)
   # (B, T, H, D) → (B·H, T, D): heads become independent grid rows.
-  to_rows = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-  qr, kr, vr = to_rows(q), to_rows(k), to_rows(v)
+  qr, kr, vr = _to_rows(q), _to_rows(k), _to_rows(v)
   grid = (b * h, t // block_q)
-  out = pl.pallas_call(
+  tile = lambda i, qi: (i, qi, 0)
+  full = lambda i, qi: (i, 0, 0)
+  out, lse = pl.pallas_call(
       functools.partial(_kernel, scale=scale, causal=causal,
                         block_q=block_q, block_k=block_k, seq_len=t),
-      out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+      out_shape=(jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+                 jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32)),
       grid=grid,
       in_specs=[
-          pl.BlockSpec((1, block_q, d), lambda i, qi: (i, qi, 0),
-                       memory_space=pltpu.VMEM),
-          pl.BlockSpec((1, t, d), lambda i, qi: (i, 0, 0),
-                       memory_space=pltpu.VMEM),
-          pl.BlockSpec((1, t, d), lambda i, qi: (i, 0, 0),
-                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, block_q, d), tile, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, t, d), full, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, t, d), full, memory_space=pltpu.VMEM),
       ],
-      out_specs=pl.BlockSpec((1, block_q, d), lambda i, qi: (i, qi, 0),
-                             memory_space=pltpu.VMEM),
+      out_specs=(
+          pl.BlockSpec((1, block_q, d), tile, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, block_q, 1), tile, memory_space=pltpu.VMEM),
+      ),
       interpret=jax.default_backend() != "tpu",
   )(qr, kr, vr)
-  return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+  out4 = _from_rows(out, b, h)
+  if with_residuals:
+    return out4, lse
+  return out4
+
+
+def _kernel_dq(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, scale: float, causal: bool, block_q: int, block_k: int,
+               seq_len: int):
+  """dq for one query tile: dq_i = Σ_j (P_ij ⊙ (dO_i V_jᵀ − Δ_i)) K_j."""
+  q = q_ref[0].astype(jnp.float32)                         # (BQ, D)
+  do = do_ref[0].astype(jnp.float32)                       # (BQ, D)
+  lse = lse_ref[0]                                         # (BQ, 1)
+  delta = delta_ref[0]                                     # (BQ, 1)
+  qi = pl.program_id(1)
+
+  def body(kj, dq_acc):
+    k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+    v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # (BQ, BK)
+    if causal:
+      s = _causal_mask(s, qi, kj, block_q, block_k)
+    p = jnp.exp(s - lse)
+    dpv = jax.lax.dot_general(
+        do, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                # (BQ, BK)
+    ds = p * (dpv - delta)
+    return dq_acc + jnp.dot(ds, k_blk,
+                            preferred_element_type=jnp.float32) * scale
+
+  if causal:
+    num_k = (qi * block_q + block_q + block_k - 1) // block_k
+  else:
+    num_k = seq_len // block_k
+  dq = jax.lax.fori_loop(
+      0, num_k, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+  dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _kernel_dkv(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale: float, causal: bool,
+                block_q: int, block_k: int, seq_len: int):
+  """dk/dv for one key tile: dV_j = Σ_i P_ijᵀ dO_i;
+  dK_j = Σ_i (P_ij ⊙ (dO_i V_jᵀ − Δ_i))ᵀ Q_i · scale."""
+  k_tile = k_ref[0].astype(jnp.float32)                    # (BK, D)
+  v_tile = v_ref[0].astype(jnp.float32)                    # (BK, D)
+  kj = pl.program_id(1)
+  head_dim = k_tile.shape[-1]
+
+  def body(qi, carry):
+    dk_acc, dv_acc = carry
+    q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+    do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(
+        jnp.float32)
+    lse_blk = lse_ref[0, pl.ds(qi * block_q, block_q), :]   # (BQ, 1)
+    delta_blk = delta_ref[0, pl.ds(qi * block_q, block_q), :]
+    s = jax.lax.dot_general(
+        q_blk, k_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # (BQ, BK)
+    if causal:
+      s = _causal_mask(s, qi, kj, block_q, block_k)
+    p = jnp.exp(s - lse_blk)                               # (BQ, BK)
+    dv_acc = dv_acc + jax.lax.dot_general(
+        p, do_blk, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # (BK, D)
+    dpv = jax.lax.dot_general(
+        do_blk, v_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                # (BQ, BK)
+    ds = p * (dpv - delta_blk)
+    dk_acc = dk_acc + jax.lax.dot_general(
+        ds, q_blk, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # (BK, D)
+    return dk_acc, dv_acc
+
+  num_q = seq_len // block_q
+  # Causal: only Q tiles whose last row reaches this K tile contribute.
+  start = (kj * block_k) // block_q if causal else 0
+  init = (jnp.zeros((block_k, head_dim), jnp.float32),
+          jnp.zeros((block_k, head_dim), jnp.float32))
+  dk, dv = jax.lax.fori_loop(start, num_q, body, init)
+  dk_ref[0] = dk.astype(dk_ref.dtype)
+  dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pallas_backward(q, k, v, out, lse, do, causal: bool,
+                     scale: float):
+  """Two-pass flash backward over the row layout; returns (dq, dk, dv)
+  in the original (B, T, H, D) layout.
+
+  `out` is the forward output in its original (B, T, H, D) layout — the
+  same array the caller's graph already keeps alive as the next layer's
+  activation, so saving it as a residual costs no extra memory.
+  """
+  b, t, h, d = q.shape
+  block_q, block_k = _block_sizes(t)
+  qr, kr, vr, dor = _to_rows(q), _to_rows(k), _to_rows(v), _to_rows(do)
+  # Δ_i = Σ_d dO_id · O_id — cheap elementwise reduction, XLA fuses it.
+  # Trailing unit dim: see the lse shape note in _kernel.
+  delta = _to_rows(jnp.sum(do.astype(jnp.float32)
+                           * out.astype(jnp.float32), axis=-1,
+                           keepdims=True))                  # (BH, T, 1)
+  interpret = jax.default_backend() != "tpu"
+  tile_q = lambda i, qi: (i, qi, 0)
+  tile_k = lambda i, kj: (i, kj, 0)
+  full = lambda i, _: (i, 0, 0)
+  kwargs = dict(scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k, seq_len=t)
+  dq = pl.pallas_call(
+      functools.partial(_kernel_dq, **kwargs),
+      out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+      grid=(b * h, t // block_q),
+      in_specs=[
+          pl.BlockSpec((1, block_q, d), tile_q, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, t, d), full, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, t, d), full, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, block_q, d), tile_q, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, block_q, 1), tile_q, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, block_q, 1), tile_q, memory_space=pltpu.VMEM),
+      ],
+      out_specs=pl.BlockSpec((1, block_q, d), tile_q,
+                             memory_space=pltpu.VMEM),
+      interpret=interpret,
+  )(qr, kr, vr, dor, lse, delta)
+  dk, dv = pl.pallas_call(
+      functools.partial(_kernel_dkv, **kwargs),
+      out_shape=(jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+                 jax.ShapeDtypeStruct((b * h, t, d), v.dtype)),
+      grid=(b * h, t // block_k),
+      in_specs=[
+          pl.BlockSpec((1, t, d), full, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, block_k, d), tile_k, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, block_k, d), tile_k, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, t, d), full, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, t, 1), full, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, t, 1), full, memory_space=pltpu.VMEM),
+      ],
+      out_specs=(
+          pl.BlockSpec((1, block_k, d), tile_k, memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, block_k, d), tile_k, memory_space=pltpu.VMEM),
+      ),
+      interpret=interpret,
+  )(qr, kr, vr, dor, lse, delta)
+  return (_from_rows(dq, b, h), _from_rows(dk, b, h),
+          _from_rows(dv, b, h))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -152,15 +322,13 @@ def _flash_attention_pallas(q, k, v, causal: bool, scale: float):
 
 
 def _fwd(q, k, v, causal, scale):
-  return _pallas_forward(q, k, v, causal, scale), (q, k, v)
+  out, lse = _pallas_forward(q, k, v, causal, scale, with_residuals=True)
+  return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, scale, residuals, grad):
-  q, k, v = residuals
-  _, vjp = jax.vjp(
-      lambda q, k, v: flash_attention_reference(q, k, v, causal, scale),
-      q, k, v)
-  return vjp(grad)
+  q, k, v, out, lse = residuals
+  return _pallas_backward(q, k, v, out, lse, grad, causal, scale)
 
 
 _flash_attention_pallas.defvjp(_fwd, _bwd)

@@ -134,18 +134,22 @@ class TestFlashAttention:
     with pytest.raises(ValueError, match="divisible"):
       flash_attention(q, k, v, implementation="pallas")
 
-  def test_gradients_match_reference(self):
-    q, k, v = self._qkv(t=128, seed=3)
+  @pytest.mark.parametrize("t,causal", [(128, True), (256, True),
+                                        (256, False), (40, True)])
+  def test_gradients_match_reference(self, t, causal):
+    # The Pallas flash backward (dq + dkv kernels) must match the
+    # dense reference for single- and multi-block T, both maskings.
+    q, k, v = self._qkv(t=t, seed=3)
     loss_p = lambda q, k, v: jnp.sum(
-        flash_attention(q, k, v, causal=True,
+        flash_attention(q, k, v, causal=causal,
                         implementation="pallas") ** 2)
     loss_r = lambda q, k, v: jnp.sum(
-        flash_attention_reference(q, k, v, causal=True) ** 2)
+        flash_attention_reference(q, k, v, causal=causal) ** 2)
     gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gp, gr):
       np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                 atol=2e-5)
+                                 atol=5e-5)
 
   def test_agrees_with_ring_attention(self):
     # The in-chip blockwise kernel and the cross-chip ring must agree:
