@@ -21,10 +21,22 @@ from tensor2robot_tpu.export.abstract_export_generator import (
 from tensor2robot_tpu.export.native_export_generator import (
     NativeExportGenerator,
 )
+from tensor2robot_tpu.export.exporters import (
+    BestExporter,
+    Exporter,
+    LatestExporter,
+    create_default_exporters_fn,
+    run_exporters,
+)
 
 __all__ = [
     "AbstractExportGenerator",
+    "BestExporter",
+    "Exporter",
+    "LatestExporter",
     "NativeExportGenerator",
+    "create_default_exporters_fn",
+    "run_exporters",
     "SPEC_ASSET_NAME",
     "latest_export_dir",
     "list_export_versions",

@@ -1,0 +1,197 @@
+"""Eval-driven exporters: latest / best-metric export policies.
+
+Reference parity: tf.estimator's LatestExporter / BestExporter wired in
+by utils/train_eval.py §create_exporters_fn (SURVEY.md §2 train/eval
+orchestrator row, §3.2 call stack) — after each evaluation the
+Estimator's EvalSpec exporters decide whether that checkpoint becomes a
+serving artifact. Here an `Exporter` is driven by the train/eval loop
+(and the continuous evaluator) with the evaluated variables and the
+eval metrics; policies decide whether to publish a new export version.
+
+Each exporter owns its own export generator instance and publishes to
+`<model_dir>/export/<name>/<version>/`, the directory robots poll.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.export import export_utils
+
+_log = logging.getLogger(__name__)
+
+
+class Exporter:
+  """Policy deciding when an eval result becomes a serving artifact."""
+
+  def __init__(self, export_generator, name: str, keep: int = 5):
+    self._generator = export_generator
+    self.name = name
+    self._keep = keep
+    self._ready = False
+
+  def begin(self, model, model_dir: str) -> None:
+    """Binds the export root and the model's specs (idempotent)."""
+    if self._ready:
+      return
+    try:
+      self._generator.export_root
+    except ValueError:
+      if not model_dir:
+        raise ValueError(
+            f"Exporter {self.name!r} needs a model_dir to place its "
+            "export root under.")
+      self._generator.export_root = os.path.join(
+          model_dir, "export", self.name)
+    self._generator.set_specification_from_model(model)
+    self._ready = True
+
+  @property
+  def export_root(self) -> str:
+    return self._generator.export_root
+
+  def after_eval(self, variables, global_step: int,
+                 eval_metrics: Dict[str, float]) -> Optional[str]:
+    """Maybe exports; returns the published dir or None.
+
+    `variables` may be the variables pytree or a zero-arg callable
+    returning it — the callable form lets callers defer the
+    device→host transfer until a policy actually publishes."""
+    raise NotImplementedError
+
+  def _export(self, variables, global_step: int) -> str:
+    if callable(variables):
+      variables = variables()
+    export_dir = export_utils.export_and_gc(
+        self._generator, variables, keep=self._keep,
+        global_step=global_step)
+    _log.info("Exporter %r published %s", self.name, export_dir)
+    return export_dir
+
+
+@configurable
+class LatestExporter(Exporter):
+  """Exports after every evaluation (tf.estimator.LatestExporter)."""
+
+  def __init__(self, export_generator, name: str = "latest",
+               keep: int = 5):
+    super().__init__(export_generator, name=name, keep=keep)
+
+  def after_eval(self, variables, global_step: int,
+                 eval_metrics: Dict[str, float]) -> Optional[str]:
+    return self._export(variables, global_step)
+
+
+@configurable
+class BestExporter(Exporter):
+  """Exports only when the tracked eval metric improves
+  (tf.estimator.BestExporter).
+
+  The best value seen is persisted to `<export_root>/best_eval.json`, so
+  a restarted eval job keeps comparing against the all-time best rather
+  than re-exporting its first evaluation.
+  """
+
+  _STATE_FILE = "best_eval.json"
+
+  def __init__(self, export_generator, name: str = "best",
+               metric_key: str = "loss", higher_is_better: bool = False,
+               keep: int = 5):
+    super().__init__(export_generator, name=name, keep=keep)
+    self._metric_key = metric_key
+    self._higher_is_better = higher_is_better
+    self._best: Optional[float] = None
+
+  def begin(self, model, model_dir: str) -> None:
+    first = not self._ready
+    super().begin(model, model_dir)
+    if first:
+      path = os.path.join(self.export_root, self._STATE_FILE)
+      if os.path.exists(path):
+        try:
+          with open(path) as f:
+            self._best = float(json.load(f)["best"])
+        except (ValueError, KeyError, TypeError):
+          # A corrupt state file (e.g. truncated by a crash predating the
+          # atomic write) must not brick the job; restart the comparison.
+          _log.warning("Ignoring unreadable %s", path)
+
+  def _improved(self, value: float) -> bool:
+    if math.isnan(value):
+      return False
+    if self._best is None:
+      return True
+    return (value > self._best if self._higher_is_better
+            else value < self._best)
+
+  def after_eval(self, variables, global_step: int,
+                 eval_metrics: Dict[str, float]) -> Optional[str]:
+    if self._metric_key not in eval_metrics:
+      raise KeyError(
+          f"BestExporter {self.name!r} tracks {self._metric_key!r} but "
+          f"eval produced {sorted(eval_metrics)}.")
+    value = float(eval_metrics[self._metric_key])
+    if not self._improved(value):
+      return None
+    export_dir = self._export(variables, global_step)
+    self._best = value
+    os.makedirs(self.export_root, exist_ok=True)
+    # Atomic tmp+rename (same protocol as export publishing): a crash
+    # mid-write must never leave a truncated state file behind.
+    path = os.path.join(self.export_root, self._STATE_FILE)
+    tmp_path = path + ".tmp"
+    with open(tmp_path, "w") as f:
+      json.dump({"best": value, "metric": self._metric_key,
+                 "global_step": int(global_step)}, f)
+    os.replace(tmp_path, path)
+    return export_dir
+
+
+@configurable
+def create_default_exporters_fn(
+    export_generator_factory: Callable[[], object],
+    best_metric_key: str = "loss",
+    higher_is_better: bool = False,
+    keep: int = 5,
+) -> Callable[[object], List[Exporter]]:
+  """Returns a create_exporters_fn making the reference's default pair:
+  a LatestExporter plus a BestExporter on `best_metric_key`
+  (utils/train_eval.py §create_exporters_fn default behaviour)."""
+
+  def create_exporters_fn(model) -> List[Exporter]:
+    del model  # exporters bind specs in begin()
+    return [
+        LatestExporter(export_generator_factory(), keep=keep),
+        BestExporter(export_generator_factory(),
+                     metric_key=best_metric_key,
+                     higher_is_better=higher_is_better, keep=keep),
+    ]
+
+  return create_exporters_fn
+
+
+def run_exporters(exporters: Sequence[Exporter], variables,
+                  global_step: int,
+                  eval_metrics: Dict[str, float]) -> Dict[str, str]:
+  """Drives every exporter after one evaluation; returns {name: dir}
+  for the ones that published. `variables` may be the pytree or a
+  zero-arg callable (fetched at most once across all exporters)."""
+  if callable(variables):
+    provider, cache = variables, []
+
+    def variables():  # noqa: F811 — memoized provider
+      if not cache:
+        cache.append(provider())
+      return cache[0]
+
+  published = {}
+  for exporter in exporters:
+    export_dir = exporter.after_eval(variables, global_step, eval_metrics)
+    if export_dir is not None:
+      published[exporter.name] = export_dir
+  return published

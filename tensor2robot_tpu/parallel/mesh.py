@@ -73,6 +73,13 @@ def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
   return NamedSharding(mesh, PartitionSpec(axis))
 
 
+def stacked_batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+  """Sharding for K-stacked batches (loop axis, batch, ...): the leading
+  scan axis is replicated, the batch dim behind it splits over `axis`
+  (consumed by Trainer.train_steps, the iterations_per_loop path)."""
+  return NamedSharding(mesh, PartitionSpec(None, axis))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
   """Fully-replicated sharding (params, opt state under pure DP)."""
   return NamedSharding(mesh, PartitionSpec())

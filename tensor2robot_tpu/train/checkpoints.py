@@ -41,9 +41,15 @@ class CheckpointManager:
         create=True)
     self._manager = ocp.CheckpointManager(self.directory, options=options)
 
-  def should_save(self, step: int) -> bool:
+  def should_save(self, step: int, last_step: Optional[int] = None) -> bool:
+    """True when `step` lands on (or, given the previous loop boundary
+    `last_step`, has crossed) a save-interval multiple. The crossing
+    form keeps the cadence honest when the train loop advances multiple
+    steps at a time (iterations_per_loop)."""
     if self.save_interval_steps <= 0:
       return False
+    if last_step is not None:
+      return step // self.save_interval_steps > last_step // self.save_interval_steps
     return step % self.save_interval_steps == 0
 
   def save(self, step: int, state: TrainState, force: bool = False) -> bool:

@@ -40,6 +40,35 @@ from tensor2robot_tpu.utils.metric_writer import MetricWriter
 _log = logging.getLogger(__name__)
 
 
+def _init_exporters(create_exporters_fn, model, model_dir: str):
+  """Builds and binds eval-driven exporters; rejects root collisions."""
+  if create_exporters_fn is None:
+    return []
+  exporters = list(create_exporters_fn(model))
+  roots = set()
+  for exporter in exporters:
+    exporter.begin(model, model_dir)
+    root = os.path.abspath(exporter.export_root)
+    if root in roots:
+      raise ValueError(
+          f"Two exporters publish to the same root {root!r}; give them "
+          "distinct names.")
+    roots.add(root)
+  return exporters
+
+
+def _run_exporters_after_eval(exporters, state, eval_metrics) -> None:
+  """Drives exporters with a lazy variables provider: the device→host
+  transfer happens at most once, and only if a policy publishes."""
+  if not exporters:
+    return
+  from tensor2robot_tpu.export.exporters import run_exporters
+  run_exporters(
+      exporters,
+      lambda: jax.device_get(state.variables(use_ema=True)),
+      int(state.step), eval_metrics)
+
+
 @dataclasses.dataclass
 class TrainEvalResult:
   state: TrainState
@@ -61,10 +90,12 @@ def train_eval_model(
     keep_checkpoint_max: int = 5,
     export_generator=None,
     export_keep: int = 5,
+    create_exporters_fn=None,
     hook_builders: Sequence[HookBuilder] = (),
     mesh=None,
     seed: int = 0,
     log_every_steps: int = 100,
+    iterations_per_loop: int = 1,
     prefetch_depth: int = 2,
 ) -> TrainEvalResult:
   """Trains (and optionally evaluates/exports) `model`.
@@ -78,6 +109,12 @@ def train_eval_model(
     save_checkpoints_steps: checkpoint cadence (0 = only final).
     export_generator: exported at end; pair with AsyncExportHookBuilder
       for continuous exports.
+    create_exporters_fn: model -> [export.exporters.Exporter]; each runs
+      after every evaluation (LatestExporter/BestExporter policies — the
+      reference's EvalSpec exporters).
+    iterations_per_loop: steps fused into one compiled lax.scan dispatch
+      (TPUConfig(iterations_per_loop)). Logging/checkpoint/eval cadences
+      then fire at the first loop boundary that crosses their multiple.
   """
   trainer = Trainer(model, mesh=mesh, seed=seed)
   state = trainer.create_train_state()
@@ -103,22 +140,40 @@ def train_eval_model(
   for hook in hooks:
     hook.begin(trainer, state, model_dir or "")
 
+  exporters = _init_exporters(create_exporters_fn, model, model_dir or "")
+
   train_metrics: Dict[str, float] = {}
   eval_metrics: Dict[str, float] = {}
 
   def run_eval(state: TrainState) -> Dict[str, float]:
     if input_generator_eval is None:
       return {}
-    return _evaluate(trainer, model, input_generator_eval, state,
-                     eval_steps, prefetch_depth)
+    metrics = _evaluate(trainer, model, input_generator_eval, state,
+                        eval_steps, prefetch_depth)
+    _run_exporters_after_eval(exporters, state, metrics)
+    return metrics
+
+  if iterations_per_loop < 1:
+    raise ValueError(f"iterations_per_loop must be >= 1, got "
+                     f"{iterations_per_loop}")
 
   if input_generator_train is not None and max_train_steps > 0:
     input_generator_train.set_specification_from_model(model, modes.TRAIN)
-    train_iter = prefetch_to_device(
-        input_generator_train.create_dataset_fn(modes.TRAIN)(),
-        sharding=trainer.batch_sharding, depth=prefetch_depth)
+    host_iter = input_generator_train.create_dataset_fn(modes.TRAIN)()
+    start_step = int(state.step)
+    if iterations_per_loop > 1:
+      from tensor2robot_tpu.parallel import mesh as mesh_lib
+      train_iter = prefetch_to_device(
+          _stack_batches(host_iter, iterations_per_loop,
+                         max_train_steps - start_step),
+          sharding=mesh_lib.stacked_batch_sharding(
+              trainer.mesh, trainer.data_axis),
+          depth=prefetch_depth)
+    else:
+      train_iter = prefetch_to_device(
+          host_iter, sharding=trainer.batch_sharding, depth=prefetch_depth)
 
-    step = int(state.step)
+    step = start_step
     pending_metrics = None
     # Bound async dispatch: a deep queue of un-synced steps buys nothing
     # (the device is saturated after ~2) and on CPU-mesh test hosts it
@@ -126,16 +181,24 @@ def train_eval_model(
     import collections
     max_inflight = max(2, prefetch_depth)
     inflight = collections.deque()
+
+    def crossed(cadence: int, prev: int, now: int) -> bool:
+      return cadence > 0 and now // cadence > prev // cadence
+
     while step < max_train_steps:
       features, labels = next(train_iter)
-      state, pending_metrics = trainer.train_step(state, features, labels)
-      step += 1
+      if iterations_per_loop > 1:
+        state, pending_metrics = trainer.train_steps(state, features, labels)
+        advanced = jax.tree_util.tree_leaves(features)[0].shape[0]
+      else:
+        state, pending_metrics = trainer.train_step(state, features, labels)
+        advanced = 1
+      prev_step, step = step, step + advanced
       inflight.append(pending_metrics["loss"])
       if len(inflight) > max_inflight:
         inflight.popleft().block_until_ready()
 
-      sync = (step % log_every_steps == 0 or step == max_train_steps)
-      if sync:
+      if crossed(log_every_steps, prev_step, step) or step == max_train_steps:
         host_metrics = {k: float(v) for k, v in pending_metrics.items()}
         train_metrics = host_metrics
         if metric_writer:
@@ -144,12 +207,13 @@ def train_eval_model(
           hook.after_step(state, host_metrics)
         _log.info("step %d: %s", step, host_metrics)
 
-      if checkpoint_manager and checkpoint_manager.should_save(step):
+      if checkpoint_manager and checkpoint_manager.should_save(
+          step, last_step=prev_step):
         checkpoint_manager.save(step, state)
         for hook in hooks:
           hook.after_checkpoint(step, state)
 
-      if (eval_interval_steps > 0 and step % eval_interval_steps == 0
+      if (crossed(eval_interval_steps, prev_step, step)
           and step < max_train_steps):
         eval_metrics = run_eval(state)
         if metric_writer and eval_metrics:
@@ -174,6 +238,14 @@ def train_eval_model(
   if export_generator is not None:
     from tensor2robot_tpu.export import export_utils
     export_utils.resolve_export_root(export_generator, model_dir)
+    if any(os.path.abspath(e.export_root)
+           == os.path.abspath(export_generator.export_root)
+           for e in exporters):
+      raise ValueError(
+          f"export_generator and an eval exporter both publish to "
+          f"{export_generator.export_root!r}; their GC policies would "
+          "delete each other's versions. Give the exporter a different "
+          "name or drop one of the two.")
     export_generator.set_specification_from_model(model)
     export_dir = export_utils.export_and_gc(
         export_generator, jax.device_get(state.variables(use_ema=True)),
@@ -193,6 +265,20 @@ def train_eval_model(
       eval_metrics=eval_metrics,
       model_dir=model_dir,
   )
+
+
+def _stack_batches(host_iter, iterations_per_loop: int, total_steps: int):
+  """Groups single host batches into (K, batch, ...) stacks for the
+  scanned multi-step. All full-size stacks except possibly one final
+  partial stack covering the remaining steps (that one compiles a second
+  executable — unavoidable when total_steps % K != 0)."""
+  remaining = total_steps
+  while remaining > 0:
+    size = min(iterations_per_loop, remaining)
+    batches = [next(host_iter) for _ in range(size)]
+    remaining -= size
+    yield jax.tree_util.tree_map(
+        lambda *leaves: np.stack(leaves), *batches)
 
 
 def _evaluate(trainer, model, input_generator_eval, state,
@@ -224,6 +310,7 @@ def continuous_eval_model(
     timeout_s: float = 3600.0,
     stop_after_step: int = 0,
     max_evaluations: int = 0,
+    create_exporters_fn=None,
     mesh=None,
     seed: int = 0,
     prefetch_depth: int = 2,
@@ -248,6 +335,7 @@ def continuous_eval_model(
   checkpoint_manager = CheckpointManager(
       os.path.join(model_dir, "checkpoints"))
   metric_writer = MetricWriter(os.path.join(model_dir, "eval"))
+  exporters = _init_exporters(create_exporters_fn, model, model_dir)
   results: Dict[int, Dict[str, float]] = {}
   stop = False
   last_new_checkpoint = time.monotonic()
@@ -267,6 +355,7 @@ def continuous_eval_model(
         metric_writer.write_scalars(
             step, {f"eval/{k}": v for k, v in metrics.items()})
         _log.info("continuous eval @ step %d: %s", step, metrics)
+        _run_exporters_after_eval(exporters, state, metrics)
         if stop_after_step and step >= stop_after_step:
           stop = True
           break

@@ -60,6 +60,7 @@ class Trainer:
     self._batch_sharding = mesh_lib.batch_sharding(self.mesh, data_axis)
     self._replicated = mesh_lib.replicated_sharding(self.mesh)
     self._train_step = None
+    self._train_steps = None
     self._eval_step = None
 
   def _constrain_params(self, params):
@@ -111,7 +112,9 @@ class Trainer:
 
   # --- steps ---------------------------------------------------------------
 
-  def _build_train_step(self):
+  def _make_train_step_fn(self):
+    """The uncompiled (state, features, labels) -> (state', metrics) body
+    shared by the single-step and scanned multi-step compilations."""
     model = self.model
     optimizer = self._optimizer
     base_rng = self._base_rng
@@ -145,6 +148,10 @@ class Trainer:
           ema_params=new_ema)
       return new_state, metrics
 
+    return step_fn
+
+  def _build_train_step(self):
+    step_fn = self._make_train_step_fn()
     if self.param_specs is None:
       return jax.jit(
           step_fn,
@@ -155,6 +162,32 @@ class Trainer:
     # TP: shardings inferred from the (already correctly placed) inputs
     # plus the in-step constraints.
     return jax.jit(step_fn, donate_argnums=(0,))
+
+  def _build_train_steps(self):
+    """K optimizer steps in one executable via lax.scan over a stacked
+    batch — the TPU-native `iterations_per_loop`: host dispatch, metric
+    sync, and Python loop overhead are amortized over K steps exactly
+    like TPUEstimator's in-device training loop (SURVEY.md §3.1
+    TPUConfig(iterations_per_loop)). RNG folds from the carried step
+    counter, so the randomness stream is identical to K single steps.
+    Returns the final state and the last step's metrics."""
+    step_fn = self._make_train_step_fn()
+
+    def many_fn(state: TrainState, features, labels):
+      def body(carry, batch):
+        new_state, metrics = step_fn(carry, batch[0], batch[1])
+        return new_state, metrics
+      state, metrics = jax.lax.scan(body, state, (features, labels))
+      return state, jax.tree_util.tree_map(lambda x: x[-1], metrics)
+
+    if self.param_specs is None:
+      stacked = mesh_lib.stacked_batch_sharding(self.mesh, self.data_axis)
+      return jax.jit(
+          many_fn,
+          in_shardings=(self._replicated, stacked, stacked),
+          out_shardings=(self._replicated, self._replicated),
+          donate_argnums=(0,))
+    return jax.jit(many_fn, donate_argnums=(0,))
 
   def _build_eval_step(self):
     model = self.model
@@ -180,6 +213,16 @@ class Trainer:
     if self._train_step is None:
       self._train_step = self._build_train_step()
     return self._train_step(state, features, labels)
+
+  def train_steps(self, state: TrainState, features, labels=None
+                  ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+    """K compiled optimizer steps over a K-stacked batch (leading loop
+    axis on every leaf). Donates `state`; returns last-step metrics.
+    Different K values compile separate executables — keep K fixed
+    except for one possible partial final loop."""
+    if self._train_steps is None:
+      self._train_steps = self._build_train_steps()
+    return self._train_steps(state, features, labels)
 
   def eval_step(self, state: TrainState, features, labels=None
                 ) -> Dict[str, jnp.ndarray]:

@@ -199,6 +199,104 @@ class TestTrainEval:
     # end() guarantees a final export even if mid-train ones were dropped.
     assert export_utils.list_export_versions(export_root)
 
+  def test_iterations_per_loop_matches_single_step(self, tmp_path):
+    # The scanned multi-step must advance the same steps and produce the
+    # same params as single-step training (identical RNG stream: both
+    # fold from the carried step counter).
+    def run(ipl, model_dir):
+      return train_eval_model(
+          MockT2RModel(),
+          input_generator_train=DefaultRandomInputGenerator(
+              batch_size=8, seed=0),
+          max_train_steps=7,  # 3 full loops of 2 + one partial of 1
+          model_dir=model_dir,
+          save_checkpoints_steps=2,
+          log_every_steps=2,
+          iterations_per_loop=ipl,
+      )
+
+    r1 = run(1, str(tmp_path / "single"))
+    r2 = run(2, str(tmp_path / "multi"))
+    assert int(r1.state.step) == int(r2.state.step) == 7
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(r1.state.params),
+                    jax.tree_util.tree_leaves(r2.state.params)):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # The crossing-based cadence still checkpointed mid-run (resume works).
+    from tensor2robot_tpu.train.checkpoints import CheckpointManager
+    manager = CheckpointManager(str(tmp_path / "multi" / "checkpoints"))
+    assert len(manager.all_steps()) > 1
+    manager.close()
+
+  def test_exporters_latest_and_best(self, tmp_path):
+    model_dir = str(tmp_path / "run")
+    from tensor2robot_tpu.export.exporters import (
+        BestExporter, LatestExporter)
+
+    best_values = []
+
+    class RecordingBest(BestExporter):
+      def after_eval(self, variables, global_step, eval_metrics):
+        out = super().after_eval(variables, global_step, eval_metrics)
+        best_values.append((global_step, out is not None))
+        return out
+
+    def create_exporters_fn(model):
+      return [LatestExporter(NativeExportGenerator(), keep=2),
+              RecordingBest(NativeExportGenerator(), metric_key="loss")]
+
+    train_eval_model(
+        MockT2RModel(),
+        input_generator_train=DefaultRandomInputGenerator(
+            batch_size=8, seed=0),
+        input_generator_eval=DefaultRandomInputGenerator(
+            batch_size=8, seed=1),
+        max_train_steps=6,
+        eval_steps=2,
+        eval_interval_steps=2,
+        model_dir=model_dir,
+        create_exporters_fn=create_exporters_fn,
+        log_every_steps=2,
+    )
+    latest_root = os.path.join(model_dir, "export", "latest")
+    best_root = os.path.join(model_dir, "export", "best")
+    # Latest exported on every eval (2 interleaved + 1 final), GC'd to 2.
+    assert len(export_utils.list_export_versions(latest_root)) == 2
+    # Best exported at least the first eval and wrote its state file.
+    assert export_utils.list_export_versions(best_root)
+    state_file = os.path.join(best_root, "best_eval.json")
+    assert os.path.isfile(state_file)
+    assert best_values[0][1]  # first eval always improves
+    best = json.load(open(state_file))
+    assert best["metric"] == "loss"
+    # A best export round-trips through the predictor.
+    predictor = ExportedModelPredictor(best_root)
+    assert predictor.restore()
+    out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+    assert out["inference_output"].shape == (2, 1)
+
+  def test_best_exporter_persists_across_restart(self, tmp_path):
+    from tensor2robot_tpu.export.exporters import BestExporter
+    model = MockT2RModel()
+    import jax
+    variables = jax.device_get(
+        __import__("tensor2robot_tpu.train.trainer",
+                   fromlist=["Trainer"]).Trainer(model)
+        .create_train_state().variables())
+    exporter = BestExporter(NativeExportGenerator(), metric_key="loss")
+    exporter.begin(model, str(tmp_path))
+    assert exporter.after_eval(variables, 1, {"loss": 1.0}) is not None
+    assert exporter.after_eval(variables, 2, {"loss": 2.0}) is None
+    assert exporter.after_eval(variables, 3, {"loss": 0.5}) is not None
+    # Fresh exporter (job restart) reloads best=0.5 from disk.
+    exporter2 = BestExporter(NativeExportGenerator(), metric_key="loss")
+    exporter2.begin(model, str(tmp_path))
+    assert exporter2.after_eval(variables, 4, {"loss": 0.7}) is None
+    assert exporter2.after_eval(variables, 5, {"loss": 0.3}) is not None
+    # Unknown metric key is a hard error, not a silent no-export.
+    with pytest.raises(KeyError):
+      exporter2.after_eval(variables, 6, {"other": 0.0})
+
   def test_fixture(self, tmp_path):
     fixture = T2RModelFixture()
     result = fixture.random_train(
