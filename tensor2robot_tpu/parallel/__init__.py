@@ -18,6 +18,13 @@ from tensor2robot_tpu.parallel.ring_attention import (
     dense_attention_reference,
     ring_attention,
 )
+from tensor2robot_tpu.parallel.ulysses_attention import (
+    ulysses_attention,
+)
+from tensor2robot_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+)
 from tensor2robot_tpu.parallel.tp_rules import (
     infer_dense_tp_specs,
     infer_dense_tp_specs_from_model,
@@ -31,7 +38,10 @@ __all__ = [
     "shard_batch",
     "local_batch_slice",
     "ring_attention",
+    "ulysses_attention",
     "dense_attention_reference",
+    "pipeline_apply",
+    "stack_stage_params",
     "infer_dense_tp_specs",
     "infer_dense_tp_specs_from_model",
     "specs_to_shardings",

@@ -107,7 +107,7 @@ def shard_batch(mesh: Mesh, batch: Any, axis: str = "data") -> Any:
   array) — the host→device boundary of SURVEY.md §3.1 without infeed
   queues.
   """
-  axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+  axis_size = mesh.shape[axis]
   leaves = jax.tree_util.tree_leaves(batch)
   if leaves:
     global_size = np.shape(leaves[0])[0] * jax.process_count()

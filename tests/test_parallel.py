@@ -16,7 +16,10 @@ from tensor2robot_tpu.parallel import (
     dense_attention_reference,
     infer_dense_tp_specs,
     infer_dense_tp_specs_from_model,
+    pipeline_apply,
     ring_attention,
+    stack_stage_params,
+    ulysses_attention,
 )
 from tensor2robot_tpu.train.trainer import Trainer
 from tensor2robot_tpu.utils.mocks import MockT2RModel
@@ -75,6 +78,152 @@ class TestRingAttention:
     g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ring, g_dense):
       np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+class TestUlyssesAttention:
+
+  @pytest.mark.parametrize("causal", [False, True])
+  def test_matches_dense_reference(self, causal):
+    # 8-way sequence parallel: heads must divide by 8.
+    mesh = create_mesh({"seq": -1})
+    q, k, v = _qkv(h=8)
+    out = ulysses_attention(q, k, v, mesh, axis="seq", causal=causal)
+    expected = dense_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5)
+
+  def test_matches_ring(self):
+    mesh = create_mesh({"seq": -1})
+    q, k, v = _qkv(h=8)
+    out_u = ulysses_attention(q, k, v, mesh, causal=True)
+    out_r = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out_u), np.asarray(out_r),
+                               atol=2e-5)
+
+  def test_dp_sp_mesh_and_bf16(self):
+    mesh = create_mesh({"data": 2, "seq": 4})
+    q, k, v = _qkv(t=16, h=4, dtype=jnp.bfloat16)
+    out = ulysses_attention(q, k, v, mesh, axis="seq",
+                            batch_axis="data", causal=True)
+    assert out.dtype == jnp.bfloat16
+    expected = dense_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(expected, np.float32),
+        atol=0.05)
+
+  def test_gradients_flow(self):
+    mesh = create_mesh({"seq": -1})
+    q, k, v = _qkv(t=16, h=8)
+
+    def loss_u(q, k, v):
+      return jnp.sum(ulysses_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+      return jnp.sum(
+          dense_attention_reference(q, k, v, causal=True) ** 2)
+
+    g_u = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_u, g_dense):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+  def test_indivisible_heads_raises(self):
+    mesh = create_mesh({"seq": -1})
+    q, k, v = _qkv(h=4)  # 4 heads over 8 shards
+    with pytest.raises(ValueError, match="divisible"):
+      ulysses_attention(q, k, v, mesh)
+
+
+class TestPipeline:
+
+  def _stages(self, num_stages=4, width=16, seed=0):
+    rng = np.random.default_rng(seed)
+    params = [
+        {"w": jnp.asarray(rng.standard_normal((width, width)),
+                          jnp.float32) * 0.3,
+         "b": jnp.asarray(rng.standard_normal((width,)), jnp.float32)}
+        for _ in range(num_stages)]
+    return params, stack_stage_params(params)
+
+  def test_matches_sequential(self):
+    width, num_stages, batch = 16, 4, 8
+    rng = np.random.default_rng(1)
+    params_list, stacked = self._stages(num_stages, width)
+    x = jnp.asarray(rng.standard_normal((batch, width)), jnp.float32)
+
+    def stage_fn(p, x):
+      return jnp.tanh(x @ p["w"] + p["b"])
+
+    mesh = create_mesh({"stage": 4}, devices=jax.devices()[:4])
+    out = pipeline_apply(stacked, x, stage_fn, mesh, axis="stage",
+                         num_microbatches=4)
+    expected = x
+    for p in params_list:
+      expected = stage_fn(p, expected)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=1e-5)
+
+  def test_more_microbatches_and_dp_axis(self):
+    width, num_stages, batch = 8, 2, 16
+    rng = np.random.default_rng(2)
+    params_list, stacked = self._stages(num_stages, width, seed=3)
+    x = jnp.asarray(rng.standard_normal((batch, width)), jnp.float32)
+
+    def stage_fn(p, x):
+      return jnp.tanh(x @ p["w"] + p["b"])
+
+    mesh = create_mesh({"data": 4, "stage": 2})
+    out = pipeline_apply(stacked, x, stage_fn, mesh, axis="stage",
+                         num_microbatches=8)
+    expected = x
+    for p in params_list:
+      expected = stage_fn(p, expected)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=1e-5)
+
+  def test_gradients_match_sequential(self):
+    width, num_stages, batch = 8, 4, 8
+    rng = np.random.default_rng(4)
+    params_list, stacked = self._stages(num_stages, width, seed=5)
+    x = jnp.asarray(rng.standard_normal((batch, width)), jnp.float32)
+
+    def stage_fn(p, x):
+      return jnp.tanh(x @ p["w"] + p["b"])
+
+    mesh = create_mesh({"stage": 4}, devices=jax.devices()[:4])
+
+    def loss_pipe(stacked):
+      return jnp.sum(
+          pipeline_apply(stacked, x, stage_fn, mesh,
+                         num_microbatches=4) ** 2)
+
+    def loss_seq(stacked):
+      h = x
+      for i in range(num_stages):
+        p = jax.tree_util.tree_map(lambda l: l[i], stacked)
+        h = stage_fn(p, h)
+      return jnp.sum(h ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+  def test_stage_count_mismatch_raises(self):
+    # 8 stacked stages on a 4-device stage axis must be an error, not a
+    # silent every-other-stage computation.
+    _, stacked = self._stages(8, 8)
+    mesh = create_mesh({"data": 2, "stage": 4})
+    with pytest.raises(ValueError, match="stages"):
+      pipeline_apply(stacked, jnp.zeros((8, 8)), lambda p, x: x, mesh)
+
+  def test_indivisible_microbatches_raises(self):
+    _, stacked = self._stages(2, 8)
+    mesh = create_mesh({"data": 4, "stage": 2})
+    with pytest.raises(ValueError, match="divisible"):
+      pipeline_apply(stacked, jnp.zeros((7, 8)), lambda p, x: x, mesh,
+                     num_microbatches=2)
 
 
 class TestSequenceParallelSnail:
