@@ -25,6 +25,12 @@ from tensor2robot_tpu.parallel.pipeline import (
     pipeline_apply,
     stack_stage_params,
 )
+from tensor2robot_tpu.parallel.expert_parallel import (
+    MoEParams,
+    expert_parallel_moe,
+    init_moe_params,
+    switch_moe,
+)
 from tensor2robot_tpu.parallel.tp_rules import (
     infer_dense_tp_specs,
     infer_dense_tp_specs_from_model,
@@ -42,6 +48,10 @@ __all__ = [
     "dense_attention_reference",
     "pipeline_apply",
     "stack_stage_params",
+    "MoEParams",
+    "expert_parallel_moe",
+    "init_moe_params",
+    "switch_moe",
     "infer_dense_tp_specs",
     "infer_dense_tp_specs_from_model",
     "specs_to_shardings",
