@@ -1,0 +1,81 @@
+"""Image encode/decode helpers (host-side, input pipeline + summaries).
+
+Reference parity: utils/image.py [LOW] (SURVEY.md §2 misc utils) — the
+reference leaned on TF's C++ image kernels for encode/decode outside the
+input pipeline. Here decode prefers the native C++ libjpeg path
+(data/_native) and falls back to PIL; encodes go through PIL. All
+functions operate on host numpy arrays — image bytes never cross the
+device boundary (strings cannot ride infeed; SURVEY.md §2
+TPUPreprocessorWrapper rationale).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+import numpy as np
+
+
+def _pil():
+  try:
+    from PIL import Image
+    return Image
+  except ImportError:  # pragma: no cover - PIL ships in this image.
+    return None
+
+
+def decode_jpeg(data: bytes) -> np.ndarray:
+  """JPEG bytes → (H, W, C) uint8 (C=1 grayscale or 3 RGB).
+
+  Delegates to the input pipeline's decoder (data/parser.py
+  §decode_image: native libjpeg with PIL fallback), so summaries and
+  tools see the exact pixels training saw — one decode path, no drift.
+  """
+  from tensor2robot_tpu.data.parser import decode_image as _decode
+  return _decode(data, data_format="jpeg")
+
+
+def decode_image(data: bytes) -> np.ndarray:
+  """Any PIL-readable format (PNG, JPEG, ...) → (H, W, C) uint8."""
+  from tensor2robot_tpu.data.parser import decode_image as _decode
+  return _decode(data)
+
+
+def _to_uint8(array: np.ndarray) -> np.ndarray:
+  array = np.asarray(array)
+  if array.dtype == np.uint8:
+    return array
+  if np.issubdtype(array.dtype, np.integer):
+    # Integer pixels are already on the 0-255 scale; just clip + cast.
+    return np.clip(array, 0, 255).astype(np.uint8)
+  # Float images in [0, 1] (the pipeline's post-decode convention).
+  return np.clip(np.asarray(array, np.float32) * 255.0 + 0.5,
+                 0, 255).astype(np.uint8)
+
+
+def encode_jpeg(array: np.ndarray, quality: int = 95) -> bytes:
+  """(H, W, C) uint8 (or [0,1] float) → JPEG bytes."""
+  pil = _pil()
+  if pil is None:
+    raise RuntimeError("JPEG encode requires PIL.")
+  array = _to_uint8(array)
+  if array.ndim == 3 and array.shape[-1] == 1:
+    array = array[..., 0]
+  buf = io.BytesIO()
+  pil.fromarray(array).save(buf, format="JPEG", quality=quality)
+  return buf.getvalue()
+
+
+def encode_png(array: np.ndarray) -> Optional[bytes]:
+  """(H, W, C) uint8 (or [0,1] float) → PNG bytes; None if PIL missing
+  (callers treat image summaries as best-effort)."""
+  pil = _pil()
+  if pil is None:
+    return None
+  array = _to_uint8(array)
+  if array.ndim == 3 and array.shape[-1] == 1:
+    array = array[..., 0]
+  buf = io.BytesIO()
+  pil.fromarray(array).save(buf, format="PNG")
+  return buf.getvalue()

@@ -58,6 +58,34 @@ class MetricWriter:
     # here means a crashed run keeps everything written so far.
     self.flush()
 
+  def write_images(self, step: int,
+                   images: Mapping[str, "np.ndarray"]) -> None:
+    """Writes (H, W, C) uint8 / [0,1]-float image summaries.
+
+    Reference parity: tf.summary image summaries (grasp2vec heatmaps
+    etc.) routed through host_call on TPU — here images are host arrays
+    at sync points, PNG-encoded into the same event file TensorBoard
+    reads. Best-effort: silently skipped without the TB proto or PIL.
+    """
+    if self._events is None or not images:
+      return
+    import numpy as np
+    from tensor2robot_tpu.utils.image import encode_png
+    event = event_pb2.Event(wall_time=time.time(), step=int(step))
+    for tag, array in images.items():
+      encoded = encode_png(array)
+      if encoded is None:  # PIL missing — global, not per-image
+        return
+      array = np.asarray(array)
+      v = event.summary.value.add()
+      v.tag = tag
+      v.image.height = array.shape[0]
+      v.image.width = array.shape[1]
+      v.image.colorspace = 1 if array.ndim == 2 else array.shape[2]
+      v.image.encoded_image_string = encoded
+    self._events.write(event.SerializeToString())
+    self.flush()
+
   def flush(self) -> None:
     self._jsonl.flush()
     if self._events is not None:

@@ -274,3 +274,46 @@ class TestGlobalStepFunctions:
     params = {"w": np.ones(2, np.float32)}
     state = opt.init(params)
     _ = opt.update({"w": np.ones(2, np.float32)}, state, params)
+
+
+class TestMetricWriterImagesAndImageUtils:
+
+  def test_image_round_trips(self):
+    from tensor2robot_tpu.utils import image as image_utils
+    rng = np.random.default_rng(0)
+    rgb = rng.integers(0, 255, (24, 32, 3), np.uint8).astype(np.uint8)
+    png = image_utils.encode_png(rgb)
+    assert png is not None
+    decoded = image_utils.decode_image(png)
+    np.testing.assert_array_equal(decoded, rgb)  # PNG is lossless
+    jpg = image_utils.encode_jpeg(rgb, quality=95)
+    decoded = image_utils.decode_jpeg(jpg)
+    assert decoded.shape == rgb.shape
+    assert decoded.dtype == np.uint8
+    # Float [0,1] input path.
+    png_f = image_utils.encode_png(rgb.astype(np.float32) / 255.0)
+    np.testing.assert_array_equal(image_utils.decode_image(png_f), rgb)
+    # Integer (non-uint8) pixels are 0-255 scale, not [0,1].
+    png_i = image_utils.encode_png(rgb.astype(np.int64))
+    np.testing.assert_array_equal(image_utils.decode_image(png_i), rgb)
+
+  def test_write_images_lands_in_event_file(self, tmp_path):
+    from tensorboard.compat.proto import event_pb2
+    from tensor2robot_tpu.data.tfrecord import read_tfrecords
+    from tensor2robot_tpu.utils.metric_writer import MetricWriter
+    logdir = str(tmp_path / "logs")
+    writer = MetricWriter(logdir)
+    rng = np.random.default_rng(1)
+    heat = rng.random((16, 16, 3)).astype(np.float32)
+    writer.write_images(7, {"eval/heatmap": heat})
+    writer.close()
+    event_files = [f for f in os.listdir(logdir)
+                   if f.startswith("events.out.tfevents")]
+    assert event_files
+    tags = []
+    for record in read_tfrecords(os.path.join(logdir, event_files[0])):
+      event = event_pb2.Event.FromString(record)
+      for value in event.summary.value:
+        if value.HasField("image"):
+          tags.append((value.tag, value.image.height, value.image.width))
+    assert tags == [("eval/heatmap", 16, 16)]
