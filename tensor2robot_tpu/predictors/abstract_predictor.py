@@ -48,6 +48,22 @@ class AbstractPredictor(abc.ABC):
     raise NotImplementedError(
         f"{type(self).__name__} does not support init_randomly.")
 
+  def device_fn(self):
+    """Device-resident serving entry for jit-composed policies.
+
+    Returns (fn, variables) where ``fn(variables, flat_features) ->
+    outputs dict`` is traceable under jax.jit — so wrappers like the
+    QT-Opt CEM loop can fuse sampling + scoring + refitting into ONE
+    compiled program per control step instead of shipping sample
+    batches across the host boundary every predict() (the host path
+    moves the tiled image H2D per CEM iteration; this path moves it
+    once). Optional: predictors without a JAX-native computation
+    (e.g. the TF SavedModel predictor) raise, and callers fall back
+    to predict().
+    """
+    raise NotImplementedError(
+        f"{type(self).__name__} has no device-resident serving path.")
+
   def close(self) -> None:
     """Releases resources."""
 

@@ -74,10 +74,13 @@ class CheckpointPredictor(AbstractPredictor):
     ema = restored.get("ema_params")
     params = ema if ema is not None else restored["params"]
     model_state = restored.get("model_state")
-    self._variables = {
+    # Device-resident: orbax restores host arrays, and keeping numpy here
+    # would re-upload the whole weight pytree on every predict()/fused
+    # control step (cf. ExportedModelPredictor.restore).
+    self._variables = jax.tree_util.tree_map(jax.numpy.asarray, {
         "params": params,
         **(model_state if model_state is not None else {}),
-    }
+    })
     self._version = int(step)
     if self._predict is None:
       self._predict = self._build_predict()
@@ -85,7 +88,7 @@ class CheckpointPredictor(AbstractPredictor):
 
   def init_randomly(self) -> None:
     variables = self._model.init_variables(jax.random.key(0))
-    self._variables = jax.device_get(variables)
+    self._variables = jax.tree_util.tree_map(jax.numpy.asarray, variables)
     self._version = 0
     if self._predict is None:
       self._predict = self._build_predict()
@@ -96,6 +99,19 @@ class CheckpointPredictor(AbstractPredictor):
     flat = self._validate_features(features)
     outputs = self._predict(self._variables, flat)
     return {k: np.asarray(v) for k, v in outputs.items()}
+
+  def device_fn(self):
+    """See AbstractPredictor.device_fn: the model's predict_fn is plain
+    traced JAX, directly composable under an outer jit."""
+    self.assert_is_loaded()
+    from tensor2robot_tpu.export import export_utils
+    model = self._model
+
+    def fn(variables, features):
+      return export_utils.normalize_serving_outputs(
+          model.predict_fn(variables, ts.TensorSpecStruct(features)))
+
+    return fn, self._variables
 
   def get_feature_specification(self) -> ts.TensorSpecStruct:
     return ts.flatten_spec_structure(
@@ -108,6 +124,8 @@ class CheckpointPredictor(AbstractPredictor):
 
   def close(self) -> None:
     self._variables = None
+    self._predict = None
+    self._version = -1  # assert_is_loaded fails cleanly after close()
     if self._manager is not None:
       self._manager.close()
       self._manager = None

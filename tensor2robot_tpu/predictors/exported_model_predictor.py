@@ -33,6 +33,7 @@ class ExportedModelPredictor(AbstractPredictor):
     self._export_root = export_root
     self._version = -1
     self._call = None
+    self._exported_call = None
     self._variables = None
     self._feature_spec: Optional[ts.TensorSpecStruct] = None
     self._feature_keys = None
@@ -54,6 +55,7 @@ class ExportedModelPredictor(AbstractPredictor):
       variables = ocp.StandardCheckpointer().restore(
           os.path.abspath(os.path.join(export_dir, VARIABLES_DIR)))
     feature_spec, _, extra = export_utils.read_spec_assets(export_dir)
+    self._exported_call = exported.call
     self._call = jax.jit(exported.call)
     self._variables = jax.tree_util.tree_map(jax.numpy.asarray, variables)
     self._feature_spec = feature_spec
@@ -77,6 +79,18 @@ class ExportedModelPredictor(AbstractPredictor):
     outputs = self._call(self._variables, *args)
     return {k: np.asarray(v) for k, v in outputs.items()}
 
+  def device_fn(self):
+    """See AbstractPredictor.device_fn: the deserialized StableHLO call
+    is traceable under an outer jit (it inlines as a call op)."""
+    self.assert_is_loaded()
+    call = self._exported_call
+    keys = tuple(self._feature_keys)
+
+    def fn(variables, features):
+      return dict(call(variables, *[features[key] for key in keys]))
+
+    return fn, self._variables
+
   def get_feature_specification(self) -> ts.TensorSpecStruct:
     self.assert_is_loaded()
     return self._feature_spec
@@ -87,4 +101,6 @@ class ExportedModelPredictor(AbstractPredictor):
 
   def close(self) -> None:
     self._call = None
+    self._exported_call = None
     self._variables = None
+    self._version = -1  # assert_is_loaded fails cleanly after close()

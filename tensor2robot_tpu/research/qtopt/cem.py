@@ -103,9 +103,17 @@ def batched_cem_optimize(
 class CEMPolicy:
   """Serving-side policy: predictor + CEM (reference §3.3 robot loop).
 
-  Wraps any predictor whose predict() exposes the Q-value under
-  ``q_predicted`` given (image, action) features: each __call__ runs
-  CEM with the image tiled across the sample batch.
+  Wraps any predictor whose serving outputs expose the Q-value under
+  ``q_predicted`` given (image, action) features.
+
+  Latency design: when the predictor offers a device-resident entry
+  (`device_fn` — native exports and checkpoint predictors do), the
+  ENTIRE control step — on-device image tiling, all CEM iterations,
+  scoring, elite refitting — compiles into one program, so per step the
+  host moves one camera image in and one action out. The reference
+  instead issued a batched session.run per CEM iteration, shipping the
+  tiled image every time; that host path is kept as the fallback for
+  predictors without a JAX computation (TF SavedModel).
   """
 
   def __init__(self, predictor, action_size: int = 4,
@@ -118,9 +126,51 @@ class CEMPolicy:
     self._iterations = iterations
     self._rng = jax.random.key(seed)
     self._calls = 0
+    self._device_control = None
+    self._device_version = None
+
+  def _build_device_control(self, fn):
+    """One fused control step: (variables, image, rng) → action."""
+    num_samples = self._num_samples
+
+    def control(variables, image, rng):
+      image = image.astype(jnp.float32)
+
+      def score(actions):
+        # Tile to the actions' (static) leading dim: cem_optimize scores
+        # (num_samples, A) batches in the loop and a single (1, A)
+        # action at the end, and exported computations bind image and
+        # action to one shared symbolic batch.
+        tiled = jnp.broadcast_to(image[None],
+                                 (actions.shape[0],) + image.shape)
+        outputs = fn(variables, {"image": tiled,
+                                 "action": actions.astype(jnp.float32)})
+        return jnp.reshape(outputs["q_predicted"], (-1,))
+
+      best, _ = cem_optimize(
+          score, rng, self._action_size, num_samples=num_samples,
+          num_elites=self._num_elites, iterations=self._iterations)
+      return best
+
+    return jax.jit(control)
 
   def __call__(self, image) -> jnp.ndarray:
     """One control step: image (H, W, C) → best action (A,)."""
+    self._calls += 1
+    rng = jax.random.fold_in(self._rng, self._calls)
+    try:
+      fn, variables = self._predictor.device_fn()
+    except NotImplementedError:
+      return self._host_call(image, rng)
+    version = self._predictor.model_version
+    if self._device_control is None or self._device_version != version:
+      # Rebuild on hot-reload; the jit cache keys on the new fn.
+      self._device_control = self._build_device_control(fn)
+      self._device_version = version
+    return self._device_control(variables, jnp.asarray(image), rng)
+
+  def _host_call(self, image, rng) -> jnp.ndarray:
+    """predict()-based fallback: one batched call per CEM iteration."""
     import numpy as np
     predictor = self._predictor
     # One dense tile per control step, reused by every CEM iteration.
@@ -134,10 +184,7 @@ class CEMPolicy:
           "action": np.asarray(actions, np.float32)})
       return jnp.asarray(outputs["q_predicted"].reshape(-1))
 
-    self._calls += 1
-    rng = jax.random.fold_in(self._rng, self._calls)
-    # Host-side CEM loop (predictor calls cross the host boundary anyway)
-    # sharing _refit with the on-device cem_optimize.
+    # Host-side CEM loop sharing _refit with the on-device cem_optimize.
     mean = jnp.zeros((self._action_size,), jnp.float32)
     std = jnp.full((self._action_size,), 0.5, jnp.float32)
     for i in range(self._iterations):

@@ -147,6 +147,39 @@ class TestQTOpt:
     action = policy(np.zeros((64, 64, 3), np.float32))
     assert action.shape == (4,)
     assert np.all(np.abs(np.asarray(action)) <= 1.0)
+    # The fused device control step was built (and is reused).
+    assert policy._device_control is not None
+    control = policy._device_control
+    policy(np.zeros((64, 64, 3), np.float32))
+    assert policy._device_control is control
+
+  def test_cem_policy_device_path_matches_host_fallback(self):
+    from tensor2robot_tpu.predictors.checkpoint_predictor import (
+        CheckpointPredictor,
+    )
+    model = QTOptGraspingModel(image_size=32)
+    predictor = CheckpointPredictor(model)
+    predictor.init_randomly()
+
+    class HostOnlyPredictor:
+      """Same model, device_fn hidden → forces the predict() fallback."""
+
+      def __getattr__(self, name):
+        if name == "device_fn":
+          raise AttributeError(name)
+        return getattr(predictor, name)
+
+      def device_fn(self):
+        raise NotImplementedError
+
+    rng = np.random.default_rng(0)
+    image = rng.random((32, 32, 3)).astype(np.float32)
+    kwargs = dict(action_size=4, num_samples=16, iterations=2, seed=3)
+    action_dev = cem.CEMPolicy(predictor, **kwargs)(image)
+    action_host = cem.CEMPolicy(HostOnlyPredictor(), **kwargs)(image)
+    # Identical RNG stream + shared _refit → identical control output.
+    np.testing.assert_allclose(np.asarray(action_dev),
+                               np.asarray(action_host), atol=1e-5)
 
 
 class TestPoseEnvMAML:
