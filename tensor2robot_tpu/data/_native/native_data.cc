@@ -265,4 +265,315 @@ int32_t t2r_jpeg_decode_batch(const uint8_t* const* datas,
   return failures.load();
 }
 
+// ---------------------------------------------------------------------------
+// tf.Example wire-format parsing (schema in data/example_proto.py).
+// The reference's input pipeline got this from TF's C++ parse_example
+// kernels; this is the rebuild's native equivalent for the per-record
+// hot loop. Proto semantics honored: unknown fields skipped, packed and
+// unpacked repeated scalars both accepted, last map entry / last oneof
+// field wins.
+// ---------------------------------------------------------------------------
+
+static bool pb_varint(const uint8_t** p, const uint8_t* end, uint64_t* v) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (*p < end && shift < 64) {
+    const uint8_t b = *(*p)++;
+    result |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *v = result;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+static bool pb_skip(const uint8_t** p, const uint8_t* end, uint32_t wire) {
+  uint64_t v;
+  switch (wire) {
+    case 0:
+      return pb_varint(p, end, &v);
+    case 1:
+      if (end - *p < 8) return false;
+      *p += 8;
+      return true;
+    case 2:
+      if (!pb_varint(p, end, &v) ||
+          static_cast<uint64_t>(end - *p) < v) return false;
+      *p += v;
+      return true;
+    case 5:
+      if (end - *p < 4) return false;
+      *p += 4;
+      return true;
+    default:
+      return false;
+  }
+}
+
+// Locates the Feature submessage for `key` in a serialized Example.
+// Returns 1 found (*feat/*feat_len set; last map entry wins), 0 not
+// found, -4 malformed.
+static int find_feature(const uint8_t* buf, uint64_t len,
+                        const uint8_t* key, int32_t key_len,
+                        const uint8_t** feat, uint64_t* feat_len) {
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  bool found = false;
+  while (p < end) {
+    uint64_t tag;
+    if (!pb_varint(&p, end, &tag)) return -4;
+    if ((tag >> 3) == 1 && (tag & 7) == 2) {  // Example.features
+      uint64_t flen;
+      if (!pb_varint(&p, end, &flen) ||
+          static_cast<uint64_t>(end - p) < flen) return -4;
+      const uint8_t* fp = p;
+      const uint8_t* fend = p + flen;
+      p = fend;
+      while (fp < fend) {  // Features.feature map entries
+        uint64_t etag;
+        if (!pb_varint(&fp, fend, &etag)) return -4;
+        if ((etag >> 3) == 1 && (etag & 7) == 2) {
+          uint64_t elen;
+          if (!pb_varint(&fp, fend, &elen) ||
+              static_cast<uint64_t>(fend - fp) < elen) return -4;
+          const uint8_t* ep = fp;
+          const uint8_t* eend = fp + elen;
+          fp = eend;
+          const uint8_t* k = nullptr;
+          uint64_t klen = 0;
+          const uint8_t* v = nullptr;
+          uint64_t vlen = 0;
+          while (ep < eend) {  // map entry: 1=key, 2=value
+            uint64_t t2;
+            if (!pb_varint(&ep, eend, &t2)) return -4;
+            const uint32_t f2 = t2 >> 3, w2 = t2 & 7;
+            if ((f2 == 1 || f2 == 2) && w2 == 2) {
+              uint64_t l;
+              if (!pb_varint(&ep, eend, &l) ||
+                  static_cast<uint64_t>(eend - ep) < l) return -4;
+              if (f2 == 1) {
+                k = ep;
+                klen = l;
+              } else {
+                v = ep;
+                vlen = l;
+              }
+              ep += l;
+            } else if (!pb_skip(&ep, eend, w2)) {
+              return -4;
+            }
+          }
+          if (k != nullptr && klen == static_cast<uint64_t>(key_len) &&
+              std::memcmp(k, key, key_len) == 0) {
+            *feat = v;
+            *feat_len = vlen;
+            found = true;  // keep scanning: last entry wins
+          }
+        } else if (!pb_skip(&fp, fend, etag & 7)) {
+          return -4;
+        }
+      }
+    } else if (!pb_skip(&p, end, tag & 7)) {
+      return -4;
+    }
+  }
+  return found ? 1 : 0;
+}
+
+// Extracts the set oneof list from a Feature: kind 1=BytesList,
+// 2=FloatList, 3=Int64List. First kind field wins — matching the
+// Python codec (example_proto.py §_decode_feature), which the fast
+// path must stay bit-identical to. Returns 1 found, 0 empty feature,
+// -4 malformed.
+static int feature_list(const uint8_t* feat, uint64_t flen, int32_t* kind,
+                        const uint8_t** list, uint64_t* list_len) {
+  const uint8_t* p = feat;
+  const uint8_t* end = feat + flen;
+  while (p < end) {
+    uint64_t tag;
+    if (!pb_varint(&p, end, &tag)) return -4;
+    const uint32_t field = tag >> 3, wire = tag & 7;
+    if (field >= 1 && field <= 3 && wire == 2) {
+      uint64_t l;
+      if (!pb_varint(&p, end, &l) ||
+          static_cast<uint64_t>(end - p) < l) return -4;
+      *kind = static_cast<int32_t>(field);
+      *list = p;
+      *list_len = l;
+      return 1;
+    }
+    if (!pb_skip(&p, end, wire)) return -4;
+  }
+  return 0;
+}
+
+// Parses FloatList content into out (exactly `cap` elements expected).
+// Returns element count, -3 on overflow, -4 malformed.
+static int64_t parse_floats(const uint8_t* list, uint64_t len, float* out,
+                            int64_t cap) {
+  const uint8_t* p = list;
+  const uint8_t* end = list + len;
+  int64_t n = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!pb_varint(&p, end, &tag)) return -4;
+    const uint32_t field = tag >> 3, wire = tag & 7;
+    if (field == 1 && wire == 2) {  // packed
+      uint64_t l;
+      if (!pb_varint(&p, end, &l) ||
+          static_cast<uint64_t>(end - p) < l) return -4;
+      // Trailing bytes beyond a multiple of 4 are ignored, matching the
+      // Python codec's size // 4 (example_proto.py §_decode_float_list).
+      const int64_t cnt = static_cast<int64_t>(l / 4);
+      if (n + cnt > cap) return -3;
+      std::memcpy(out + n, p, cnt * 4);
+      n += cnt;
+      p += l;
+    } else if (field == 1 && wire == 5) {  // unpacked
+      if (end - p < 4) return -4;
+      if (n + 1 > cap) return -3;
+      std::memcpy(out + n, p, 4);
+      n += 1;
+      p += 4;
+    } else if (!pb_skip(&p, end, wire)) {
+      return -4;
+    }
+  }
+  return n;
+}
+
+// Parses Int64List content into out. Same contract as parse_floats.
+static int64_t parse_int64s(const uint8_t* list, uint64_t len, int64_t* out,
+                            int64_t cap) {
+  const uint8_t* p = list;
+  const uint8_t* end = list + len;
+  int64_t n = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!pb_varint(&p, end, &tag)) return -4;
+    const uint32_t field = tag >> 3, wire = tag & 7;
+    if (field == 1 && wire == 2) {  // packed varints
+      uint64_t l;
+      if (!pb_varint(&p, end, &l) ||
+          static_cast<uint64_t>(end - p) < l) return -4;
+      const uint8_t* vp = p;
+      const uint8_t* vend = p + l;
+      p = vend;
+      while (vp < vend) {
+        uint64_t v;
+        if (!pb_varint(&vp, vend, &v)) return -4;
+        if (n + 1 > cap) return -3;
+        out[n++] = static_cast<int64_t>(v);  // two's complement
+      }
+    } else if (field == 1 && wire == 0) {  // unpacked
+      uint64_t v;
+      if (!pb_varint(&p, end, &v)) return -4;
+      if (n + 1 > cap) return -3;
+      out[n++] = static_cast<int64_t>(v);
+    } else if (!pb_skip(&p, end, wire)) {
+      return -4;
+    }
+  }
+  return n;
+}
+
+// Returns the FIRST bytes value's span; count of values via *count.
+// Returns 0 ok, -4 malformed.
+static int32_t parse_bytes_first(const uint8_t* list, uint64_t len,
+                                 const uint8_t** ptr, uint64_t* blen,
+                                 int64_t* count) {
+  const uint8_t* p = list;
+  const uint8_t* end = list + len;
+  *count = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!pb_varint(&p, end, &tag)) return -4;
+    if ((tag >> 3) == 1 && (tag & 7) == 2) {
+      uint64_t l;
+      if (!pb_varint(&p, end, &l) ||
+          static_cast<uint64_t>(end - p) < l) return -4;
+      if (*count == 0) {
+        *ptr = p;
+        *blen = l;
+      }
+      ++(*count);
+      p += l;
+    } else if (!pb_skip(&p, end, tag & 7)) {
+      return -4;
+    }
+  }
+  return 0;
+}
+
+// Parses one dense numeric feature across a batch of records straight
+// into a contiguous output array ((batch, elems), float32 for kind 2 /
+// int64 for kind 3). Returns 0 ok; on failure sets *err_index to the
+// offending record and returns: -1 feature missing, -2 kind mismatch
+// (or empty feature), -3 element-count mismatch, -4 malformed proto.
+int32_t t2r_example_batch_dense(const uint8_t* const* bufs,
+                                const uint64_t* lens, int32_t batch,
+                                const uint8_t* key, int32_t key_len,
+                                int32_t kind, int64_t elems, void* out,
+                                int64_t* err_index) {
+  if (kind != 2 && kind != 3) return -2;
+  for (int32_t b = 0; b < batch; ++b) {
+    *err_index = b;
+    const uint8_t* feat = nullptr;
+    uint64_t flen = 0;
+    int rc = find_feature(bufs[b], lens[b], key, key_len, &feat, &flen);
+    if (rc < 0) return -4;
+    if (rc == 0) return -1;
+    int32_t fk = 0;
+    const uint8_t* list = nullptr;
+    uint64_t list_len = 0;
+    rc = feature_list(feat, flen, &fk, &list, &list_len);
+    if (rc < 0) return -4;
+    if (rc == 0 || fk != kind) return -2;
+    int64_t n;
+    if (kind == 2) {
+      n = parse_floats(list, list_len,
+                       static_cast<float*>(out) + b * elems, elems);
+    } else {
+      n = parse_int64s(list, list_len,
+                       static_cast<int64_t*>(out) + b * elems, elems);
+    }
+    if (n == -4) return -4;
+    if (n < 0 || n != elems) return -3;
+  }
+  *err_index = -1;
+  return 0;
+}
+
+// Extracts one bytes feature (first value) per record, zero-copy:
+// ptrs[i]/out_lens[i] point INTO bufs[i]. Returns 0 ok; errors as in
+// t2r_example_batch_dense.
+int32_t t2r_example_batch_bytes(const uint8_t* const* bufs,
+                                const uint64_t* lens, int32_t batch,
+                                const uint8_t* key, int32_t key_len,
+                                const uint8_t** ptrs, uint64_t* out_lens,
+                                int64_t* err_index) {
+  for (int32_t b = 0; b < batch; ++b) {
+    *err_index = b;
+    const uint8_t* feat = nullptr;
+    uint64_t flen = 0;
+    int rc = find_feature(bufs[b], lens[b], key, key_len, &feat, &flen);
+    if (rc < 0) return -4;
+    if (rc == 0) return -1;
+    int32_t fk = 0;
+    const uint8_t* list = nullptr;
+    uint64_t list_len = 0;
+    rc = feature_list(feat, flen, &fk, &list, &list_len);
+    if (rc < 0) return -4;
+    if (rc == 0 || fk != 1) return -2;
+    int64_t count = 0;
+    if (parse_bytes_first(list, list_len, &ptrs[b], &out_lens[b],
+                          &count) != 0) return -4;
+    if (count < 1) return -3;
+  }
+  *err_index = -1;
+  return 0;
+}
+
 }  // extern "C"

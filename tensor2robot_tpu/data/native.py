@@ -51,6 +51,18 @@ class NativeData:
           ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
           ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
           ctypes.POINTER(ctypes.c_int32)]
+    if hasattr(lib, "t2r_example_batch_dense"):
+      lib.t2r_example_batch_dense.restype = ctypes.c_int32
+      lib.t2r_example_batch_dense.argtypes = [
+          ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+          ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+          ctypes.c_int64, ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+      lib.t2r_example_batch_bytes.restype = ctypes.c_int32
+      lib.t2r_example_batch_bytes.argtypes = [
+          ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+          ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+          ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+          ctypes.POINTER(ctypes.c_int64)]
 
   def masked_crc32c(self, data: bytes) -> int:
     return self._lib.t2r_masked_crc32c(data, len(data))
@@ -139,6 +151,71 @@ class NativeData:
         height, width, channels, n, num_threads,
         statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     return out, statuses
+
+
+  # --- tf.Example parsing ---------------------------------------------------
+
+  @property
+  def has_example_parse(self) -> bool:
+    return hasattr(self._lib, "t2r_example_batch_dense")
+
+  def example_batch_dense(self, records: "list[bytes]", name: str,
+                          kind: int, elems: int) -> Optional[np.ndarray]:
+    """Parses feature `name` from every record into a (N, elems) array
+    (kind 2 → float32 FloatList, 3 → int64 Int64List), entirely in C++.
+
+    Returns None when the records don't match the request (missing
+    feature / different wire kind / count mismatch) — callers fall back
+    to the Python codec, which produces the precise error if the data is
+    genuinely wrong. Raises on malformed protos (corrupt data is never
+    silently skipped).
+    """
+    n = len(records)
+    dtype = np.float32 if kind == 2 else np.int64
+    out = np.empty((n, elems), dtype)
+    if n == 0:
+      return out
+    datas = (ctypes.c_char_p * n)(*records)
+    lens = (ctypes.c_uint64 * n)(*(len(r) for r in records))
+    err_index = ctypes.c_int64(-1)
+    rc = self._lib.t2r_example_batch_dense(
+        datas, lens, n, name.encode("utf-8"), len(name.encode("utf-8")),
+        kind, elems, out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.byref(err_index))
+    if rc == 0:
+      return out
+    if rc == -4:
+      raise ValueError(
+          f"Malformed tf.Example proto at record {err_index.value} "
+          f"(feature {name!r})")
+    return None
+
+  def example_batch_bytes(self, records: "list[bytes]",
+                          name: str) -> Optional["list[bytes]"]:
+    """Extracts the (first) bytes value of feature `name` per record.
+
+    Same None-fallback / raise-on-malformed contract as
+    example_batch_dense.
+    """
+    n = len(records)
+    if n == 0:
+      return []
+    datas = (ctypes.c_char_p * n)(*records)
+    lens = (ctypes.c_uint64 * n)(*(len(r) for r in records))
+    ptrs = (ctypes.c_void_p * n)()
+    out_lens = (ctypes.c_uint64 * n)()
+    err_index = ctypes.c_int64(-1)
+    rc = self._lib.t2r_example_batch_bytes(
+        datas, lens, n, name.encode("utf-8"), len(name.encode("utf-8")),
+        ptrs, out_lens, ctypes.byref(err_index))
+    if rc == 0:
+      # Copy out while `records` (the backing buffers) are alive.
+      return [ctypes.string_at(ptrs[i], out_lens[i]) for i in range(n)]
+    if rc == -4:
+      raise ValueError(
+          f"Malformed tf.Example proto at record {err_index.value} "
+          f"(feature {name!r})")
+    return None
 
 
 def get_native(auto_build: bool = True) -> Optional[NativeData]:

@@ -18,25 +18,35 @@ import numpy as np
 from tensor2robot_tpu.data import example_proto
 from tensor2robot_tpu.specs import tensorspec_utils as ts
 
+_UNSET = object()
 
-def decode_image(data: bytes, data_format: Optional[str] = None) -> np.ndarray:
+
+def decode_image(data: bytes, data_format: Optional[str] = None,
+                 channels: Optional[int] = None) -> np.ndarray:
   """Decodes an encoded image to an HWC uint8 array.
 
   JPEGs go through the native libjpeg kernel when available (the input
   pipeline's hot loop — SURVEY.md §3.1); PIL handles everything else and
-  serves as the fallback.
+  serves as the fallback. `channels` (1 or 3) converts colorspace like
+  TF's decode_jpeg(channels=N) — the conversion rule must be identical
+  on the native and PIL paths so a dataset parses the same with or
+  without the toolchain.
   """
   if data_format is None or data_format == "jpeg":
     from tensor2robot_tpu.data import native
     lib = native.get_native()
     if lib is not None and data[:2] == b"\xff\xd8":  # JPEG SOI marker
       try:
-        return lib.jpeg_decode(data)
+        return lib.jpeg_decode(data, channels=channels)
       except ValueError:
         pass  # e.g. CMYK: libjpeg can't convert — PIL below can
   from PIL import Image  # host-side decode only; never on device
 
   with Image.open(io.BytesIO(data)) as img:
+    if channels == 1 and img.mode != "L":
+      img = img.convert("L")
+    elif channels == 3 and img.mode != "RGB":
+      img = img.convert("RGB")
     arr = np.asarray(img)
   if arr.ndim == 2:
     arr = arr[:, :, None]
@@ -78,6 +88,7 @@ class ExampleParser:
     for key, spec in self._label_spec.items():
       name = spec.name or key.rsplit("/", 1)[-1]
       self._routes.setdefault(name, []).append(("labels", key, spec))
+    self._native_plan_cache = _UNSET
 
   def parse_single(self, serialized: bytes):
     """Parses one record → (features, labels) of unbatched numpy arrays."""
@@ -102,7 +113,10 @@ class ExampleParser:
     if ts.is_encoded_image_spec(spec):
       if not values or not isinstance(values[0], bytes):
         raise ValueError(f"Feature {name!r}: expected encoded image bytes")
-      img = decode_image(values[0], spec.data_format)
+      channels = (spec.shape[-1]
+                  if len(spec.shape) == 3 and spec.shape[-1] in (1, 3)
+                  else None)
+      img = decode_image(values[0], spec.data_format, channels=channels)
       if img.shape != spec.shape:
         raise ValueError(
             f"Feature {name!r}: decoded image shape {img.shape} != spec "
@@ -141,10 +155,103 @@ class ExampleParser:
     return arr.reshape(spec.shape).astype(spec.dtype, copy=False)
 
   def parse_batch(self, serialized_records: List[bytes]):
-    """Parses and stacks records → batched (features, labels)."""
+    """Parses and stacks records → batched (features, labels).
+
+    Fast path: when the native library is available and every route is
+    dense (fixed-shape numeric or jpeg image, nothing optional/varlen),
+    the whole batch parses in C++ — proto walking, value extraction,
+    and thread-pooled jpeg decode — without constructing per-record
+    Python objects (the reference's parse_example C++ kernels). Any
+    mismatch between the plan and the actual records falls back to the
+    per-record Python codec, which raises the precise error.
+    """
+    serialized_records = list(serialized_records)
+    from tensor2robot_tpu.data import native
+    lib = native.get_native()
+    if (lib is not None and lib.has_example_parse
+        and lib.has_batch_decode):
+      result = self._parse_batch_native(serialized_records, lib)
+      if result is not None:
+        return result
     parsed = [self.parse_single(r) for r in serialized_records]
     features = _stack_structs([p[0] for p in parsed])
     labels = _stack_structs([p[1] for p in parsed])
+    return features, labels
+
+  @property
+  def _native_plan(self):
+    """Per-record-feature parse plan, or None if any route needs the
+    Python codec (optional/varlen/sequence/unsupported dtype)."""
+    if self._native_plan_cache is not _UNSET:
+      return self._native_plan_cache
+    plan = []
+    for name, routes in self._routes.items():
+      spec = routes[0][2]  # schema build validated cross-route agreement
+      if any(s.is_optional for _, _, s in routes):
+        plan = None
+        break
+      if ts.is_encoded_image_spec(spec):
+        if (spec.data_format == "jpeg" and len(spec.shape) == 3
+            and spec.shape[-1] in (1, 3)):
+          plan.append(("jpeg", name, routes, spec))
+          continue
+        plan = None
+        break
+      if spec.is_sequence or spec.varlen_default_value is not None:
+        plan = None
+        break
+      elems = int(np.prod(spec.shape)) if spec.shape else 1
+      if np.issubdtype(spec.dtype, np.floating):
+        plan.append(("float", name, routes, elems))
+      elif np.issubdtype(spec.dtype, np.integer):
+        plan.append(("int", name, routes, elems))
+      else:
+        plan = None
+        break
+    self._native_plan_cache = plan
+    return plan
+
+  def _parse_batch_native(self, records: List[bytes], lib):
+    """C++ whole-batch parse; None → caller uses the Python path."""
+    plan = self._native_plan
+    if plan is None or not records:
+      return None
+    n = len(records)
+    features = ts.TensorSpecStruct()
+    labels = ts.TensorSpecStruct()
+    for kind, name, routes, extra in plan:
+      if kind == "jpeg":
+        spec = extra
+        blobs = lib.example_batch_bytes(records, name)
+        if blobs is None:
+          return None
+        h, w, c = spec.shape
+        images, statuses = lib.jpeg_decode_batch(blobs, h, w, c)
+        if statuses.any():
+          return None  # Python path raises the precise per-record error
+        arr = images
+      else:
+        elems = extra
+        proto_kind = 2 if kind == "float" else 3
+        arr = lib.example_batch_dense(records, name, proto_kind, elems)
+        if arr is None:
+          # Raw-bytes tensor encoding (single bytes value = .tobytes()).
+          blobs = lib.example_batch_bytes(records, name)
+          if blobs is None:
+            return None
+          spec = routes[0][2]
+          itemsize = np.dtype(spec.dtype).itemsize
+          if any(len(b) != elems * itemsize for b in blobs):
+            return None
+          arr = np.stack(
+              [np.frombuffer(b, dtype=spec.dtype) for b in blobs])
+      for i, (dest, key, spec) in enumerate(routes):
+        out = features if dest == "features" else labels
+        shaped = arr.reshape((n,) + spec.shape)
+        # Routes beyond the first get independent copies — the Python
+        # path materializes per-route arrays, and aliased buffers would
+        # let an in-place feature mutation corrupt its label twin.
+        out[key] = shaped.astype(spec.dtype, copy=i > 0)
     return features, labels
 
 

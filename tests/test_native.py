@@ -202,3 +202,191 @@ class TestBatchJpegDecode:
     out, statuses = lib.jpeg_decode_batch(images, 32, 32, channels=1)
     assert (statuses == 0).all()
     assert out.shape == (4, 32, 32, 1)
+
+
+class TestNativeExampleParse:
+
+  def _records(self, n=8, seed=0, image=False, raw_bytes=False):
+    rng = np.random.default_rng(seed)
+    records = []
+    truths = []
+    for i in range(n):
+      feats = {
+          "action": [float(x) for x in rng.standard_normal(4)],
+          "step": [int(i), int(i + 1)],
+      }
+      if raw_bytes:
+        feats["state"] = [rng.standard_normal(3).astype(np.float32)
+                          .tobytes()]
+      if image:
+        feats["image"] = [_jpeg_bytes(h=32, w=32, seed=i)]
+      truths.append(feats)
+      records.append(example_proto.encode_example(feats))
+    return records, truths
+
+  def test_dense_float_and_int_parity(self):
+    lib = native.get_native()
+    records, truths = self._records()
+    floats = lib.example_batch_dense(records, "action", 2, 4)
+    np.testing.assert_allclose(
+        floats, np.asarray([t["action"] for t in truths], np.float32))
+    ints = lib.example_batch_dense(records, "step", 3, 2)
+    assert ints.dtype == np.int64
+    np.testing.assert_array_equal(
+        ints, np.asarray([t["step"] for t in truths]))
+
+  def test_dense_mismatches_return_none(self):
+    lib = native.get_native()
+    records, _ = self._records()
+    assert lib.example_batch_dense(records, "missing", 2, 4) is None
+    assert lib.example_batch_dense(records, "action", 3, 4) is None  # kind
+    assert lib.example_batch_dense(records, "action", 2, 5) is None  # count
+
+  def test_malformed_proto_raises(self):
+    lib = native.get_native()
+    with pytest.raises(ValueError, match="[Mm]alformed"):
+      lib.example_batch_dense([b"\x0a\xff\xff\xff\xff\x7f"], "x", 2, 1)
+
+  def test_bytes_extraction(self):
+    lib = native.get_native()
+    records, truths = self._records(raw_bytes=True)
+    blobs = lib.example_batch_bytes(records, "state")
+    assert blobs == [t["state"][0] for t in truths]
+
+  def test_negative_int64_round_trip(self):
+    lib = native.get_native()
+    rec = example_proto.encode_example({"v": [-5, -1, 3]})
+    out = lib.example_batch_dense([rec], "v", 3, 3)
+    np.testing.assert_array_equal(out[0], [-5, -1, 3])
+
+  def test_parser_uses_native_path_and_matches_python(self, monkeypatch):
+    from tensor2robot_tpu.specs import tensorspec_utils as ts
+    records, _ = self._records(image=True, raw_bytes=True)
+    feature_spec = ts.TensorSpecStruct({
+        "image": ts.ExtendedTensorSpec((32, 32, 3), np.uint8,
+                                       name="image", data_format="jpeg"),
+        "action": ts.ExtendedTensorSpec((4,), np.float32, name="action"),
+        "state": ts.ExtendedTensorSpec((3,), np.float32, name="state"),
+    })
+    label_spec = ts.TensorSpecStruct({
+        "step": ts.ExtendedTensorSpec((2,), np.int32, name="step"),
+    })
+    p = parser.ExampleParser(feature_spec, label_spec)
+    assert p._native_plan is not None  # the fast path is live
+    feats_n, labels_n = p.parse_batch(records)
+    # Force the Python codec and compare bit-for-bit.
+    p2 = parser.ExampleParser(feature_spec, label_spec)
+    monkeypatch.setattr(p2, "_native_plan_cache", None)
+    feats_p, labels_p = p2.parse_batch(records)
+    assert set(feats_n) == set(feats_p)
+    for k in feats_n:
+      np.testing.assert_array_equal(feats_n[k], feats_p[k])
+      assert feats_n[k].dtype == feats_p[k].dtype
+    np.testing.assert_array_equal(labels_n["step"], labels_p["step"])
+    assert labels_n["step"].dtype == np.int32
+
+  def test_parser_plan_ineligible_for_varlen_and_optional(self):
+    from tensor2robot_tpu.specs import tensorspec_utils as ts
+    p = parser.ExampleParser(ts.TensorSpecStruct({
+        "seq": ts.ExtendedTensorSpec((5, 2), np.float32, name="seq",
+                                     is_sequence=True)}))
+    assert p._native_plan is None
+    p = parser.ExampleParser(ts.TensorSpecStruct({
+        "opt": ts.ExtendedTensorSpec((2,), np.float32, name="opt",
+                                     is_optional=True)}))
+    assert p._native_plan is None
+
+  def test_parser_falls_back_on_missing_feature(self):
+    from tensor2robot_tpu.specs import tensorspec_utils as ts
+    records = [example_proto.encode_example({"other": [1.0]})]
+    p = parser.ExampleParser(ts.TensorSpecStruct({
+        "action": ts.ExtendedTensorSpec((1,), np.float32, name="action")}))
+    with pytest.raises(ValueError, match="missing required feature"):
+      p.parse_batch(records)
+
+  def test_speed_vs_python(self):
+    lib = native.get_native()
+    records, _ = self._records(n=256, seed=1)
+    start = time.perf_counter()
+    for _ in range(20):
+      lib.example_batch_dense(records, "action", 2, 4)
+    native_t = time.perf_counter() - start
+    start = time.perf_counter()
+    for _ in range(20):
+      np.stack([np.asarray(
+          example_proto.decode_example(r)["action"], np.float32)
+          for r in records])
+    python_t = time.perf_counter() - start
+    assert native_t < python_t, (native_t, python_t)
+
+
+class TestExampleParseParity:
+  """Wire-level edge cases where the C++ and Python codecs must agree."""
+
+  @staticmethod
+  def _varint(v):
+    out = bytearray()
+    while True:
+      b = v & 0x7F
+      v >>= 7
+      out.append(b | 0x80 if v else b)
+      if not v:
+        return bytes(out)
+
+  def _example(self, feature_payload, name=b"a"):
+    v = self._varint
+    entry = (b"\x0a" + v(len(name)) + name
+             + b"\x12" + v(len(feature_payload)) + feature_payload)
+    features = b"\x0a" + v(len(entry)) + entry
+    return b"\x0a" + v(len(features)) + features
+
+  def _float_list(self, values, trailing=b""):
+    import struct
+    packed = struct.pack(f"<{len(values)}f", *values) + trailing
+    payload = b"\x0a" + self._varint(len(packed)) + packed
+    return b"\x12" + self._varint(len(payload)) + payload
+
+  def test_duplicate_oneof_first_wins_both_paths(self):
+    lib = native.get_native()
+    feature = self._float_list([1.0, 2.0]) + self._float_list([9.0, 9.0])
+    record = self._example(feature)
+    assert example_proto.decode_example(record)["a"] == [1.0, 2.0]
+    out = lib.example_batch_dense([record], "a", 2, 2)
+    np.testing.assert_array_equal(out[0], [1.0, 2.0])
+
+  def test_trailing_packed_bytes_ignored_both_paths(self):
+    lib = native.get_native()
+    record = self._example(
+        self._float_list([1.0, 2.0, 3.0, 4.0], trailing=b"\xab\xcd"))
+    assert example_proto.decode_example(record)["a"] == [1.0, 2.0, 3.0, 4.0]
+    out = lib.example_batch_dense([record], "a", 2, 4)
+    np.testing.assert_array_equal(out[0], [1.0, 2.0, 3.0, 4.0])
+
+  def test_grayscale_jpeg_with_rgb_spec_parses_same_both_paths(self):
+    from tensor2robot_tpu.specs import tensorspec_utils as ts
+    gray = _jpeg_bytes(h=32, w=32, seed=5, gray=True)
+    records = [example_proto.encode_example({"image": [gray]})]
+    spec = ts.TensorSpecStruct({
+        "image": ts.ExtendedTensorSpec((32, 32, 3), np.uint8,
+                                       name="image", data_format="jpeg")})
+    p_native = parser.ExampleParser(spec)
+    assert p_native._native_plan is not None
+    feats_n, _ = p_native.parse_batch(records)
+    p_python = parser.ExampleParser(spec)
+    p_python._native_plan_cache = None
+    feats_p, _ = p_python.parse_batch(records)
+    # Both paths convert to the spec's channel count (TF decode_jpeg
+    # semantics) — neither works-on-one-machine-crashes-on-another.
+    np.testing.assert_array_equal(feats_n["image"], feats_p["image"])
+
+  def test_multi_route_outputs_do_not_alias(self):
+    from tensor2robot_tpu.specs import tensorspec_utils as ts
+    records = [example_proto.encode_example({"pose": [1.0, 2.0]})]
+    spec = ts.ExtendedTensorSpec((2,), np.float32, name="pose")
+    p = parser.ExampleParser(
+        ts.TensorSpecStruct({"pose": spec}),
+        ts.TensorSpecStruct({"pose": spec}))
+    assert p._native_plan is not None
+    feats, labels = p.parse_batch(records)
+    feats["pose"][0, 0] = 99.0
+    assert labels["pose"][0, 0] == 1.0
