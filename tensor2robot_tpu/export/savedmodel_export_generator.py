@@ -88,7 +88,7 @@ class SavedModelExportGenerator(AbstractExportGenerator):
     signatures = {"serving_default": serving_default}
 
     if self._with_tf_example_signature:
-      parse_schema = self._tf_example_schema(tf, feature_spec)
+      parse_schema, raw_keys = self._tf_example_schema(tf, feature_spec)
 
       @tf.function(
           input_signature=[tf.TensorSpec([None], tf.string, name="input")])
@@ -102,6 +102,13 @@ class SavedModelExportGenerator(AbstractExportGenerator):
                 lambda s: tf.io.decode_image(
                     s, channels=spec.shape[-1], expand_animations=False),
                 value, fn_output_signature=tf.uint8)
+            value = tf.reshape(value, (-1,) + spec.shape)
+          elif key in raw_keys:
+            # Raw-bytes tensor convention (array.tobytes() as a single
+            # bytes value — the same wire format data/parser.py accepts)
+            # for dtypes tf.io.parse_example cannot parse directly.
+            value = tf.io.decode_raw(
+                value, tf.as_dtype(np.dtype(spec.dtype)))
             value = tf.reshape(value, (-1,) + spec.shape)
           arrays.append(value)
         return tf_fn(_rebuild(), *arrays)
@@ -119,8 +126,16 @@ class SavedModelExportGenerator(AbstractExportGenerator):
 
   @staticmethod
   def _tf_example_schema(tf, feature_spec: ts.TensorSpecStruct):
-    """Specs → tf.io parse schema (reference §tensorspec_to_feature_dict)."""
+    """Specs → (tf.io parse schema, raw-bytes keys).
+
+    Reference §tensorspec_to_feature_dict. Dtypes tf.io.parse_example
+    cannot parse (anything outside float32/int64/string — e.g. the
+    uint8 image wire format) are declared as raw-bytes string features
+    and decode_raw'd in the serving fn.
+    """
+    parseable = {np.dtype(np.float32), np.dtype(np.int64)}
     schema = {}
+    raw_keys = set()
     for key, spec in feature_spec.items():
       if ts.is_encoded_image_spec(spec):
         schema[key] = tf.io.FixedLenFeature([], tf.string)
@@ -129,7 +144,10 @@ class SavedModelExportGenerator(AbstractExportGenerator):
             spec.shape[1:], tf.as_dtype(np.dtype(spec.dtype)),
             allow_missing=True,
             default_value=spec.varlen_default_value)
+      elif np.dtype(spec.dtype) not in parseable:
+        schema[key] = tf.io.FixedLenFeature([], tf.string)
+        raw_keys.add(key)
       else:
         schema[key] = tf.io.FixedLenFeature(
             spec.shape, tf.as_dtype(np.dtype(spec.dtype)))
-    return schema
+    return schema, raw_keys

@@ -154,9 +154,10 @@ class ImagePreprocessor(AbstractPreprocessor):
           f"{list(self._out_feature_spec)}")
     self._image_key = image_key
     out_image = self._out_feature_spec[image_key]
-    if not np.issubdtype(out_image.dtype, np.floating):
+    if not (np.issubdtype(out_image.dtype, np.floating)
+            or out_image.dtype == np.uint8):
       raise ValueError(
-          f"Out image spec must be float (model-ready), got "
+          f"Out image spec must be float or uint8 (model-ready), got "
           f"{out_image.dtype}")
     in_shape = tuple(in_image_shape) if in_image_shape else out_image.shape
     # In-spec: parsed as encoded uint8 image at the pre-crop size.
@@ -198,19 +199,29 @@ class ImagePreprocessor(AbstractPreprocessor):
   def _preprocess_fn(self, features, labels, mode):
     out = ts.TensorSpecStruct(features)
     images = np.asarray(features[self._image_key])
-    target_h, target_w = self._out_feature_spec[self._image_key].shape[:2]
+    out_spec = self._out_feature_spec[self._image_key]
+    target_h, target_w = out_spec.shape[:2]
+    uint8_out = out_spec.dtype == np.uint8
     # Crop on uint8 first: converting the full pre-crop batch to float32
     # would waste host bandwidth in the pipeline threads.
     if mode == modes.TRAIN:
       if images.shape[1:3] != (target_h, target_w):
         images = random_crop(images, target_h, target_w, self._rng)
-      images = images.astype(np.float32) / 255.0
       if self._distort:
-        images = apply_photometric_distortions(images, self._rng, copy=False)
+        images = apply_photometric_distortions(
+            images.astype(np.float32) / 255.0, self._rng, copy=False)
+      elif not uint8_out:
+        images = images.astype(np.float32) / 255.0
     else:
       if images.shape[1:3] != (target_h, target_w):
         images = center_crop(images, target_h, target_w)
-      images = images.astype(np.float32) / 255.0
-    out[self._image_key] = images.astype(
-        self._out_feature_spec[self._image_key].dtype, copy=False)
+      if not uint8_out:
+        images = images.astype(np.float32) / 255.0
+    if uint8_out and images.dtype != np.uint8:
+      # Distorted floats round back to the uint8 wire format; the model
+      # rescales on device (layers.normalize_image) — uint8 crosses
+      # host→device at a quarter of the float32 bytes.
+      from tensor2robot_tpu.utils.image import to_uint8
+      images = to_uint8(images)
+    out[self._image_key] = images.astype(out_spec.dtype, copy=False)
     return out, labels

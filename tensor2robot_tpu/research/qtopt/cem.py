@@ -134,7 +134,8 @@ class CEMPolicy:
     num_samples = self._num_samples
 
     def control(variables, image, rng):
-      image = image.astype(jnp.float32)
+      # Image dtype is the model's wire format (float32, or uint8 on
+      # the bandwidth-saving path) — pass it through untouched.
 
       def score(actions):
         # Tile to the actions' (static) leading dim: cem_optimize scores
@@ -174,9 +175,10 @@ class CEMPolicy:
     import numpy as np
     predictor = self._predictor
     # One dense tile per control step, reused by every CEM iteration.
+    # Dtype passes through: the model's wire format (float32 or uint8).
+    image = np.asarray(image)
     tiled = np.ascontiguousarray(np.broadcast_to(
-        np.asarray(image, np.float32)[None],
-        (self._num_samples,) + image.shape))
+        image[None], (self._num_samples,) + image.shape))
 
     def score(actions: jnp.ndarray) -> jnp.ndarray:
       outputs = predictor.predict({

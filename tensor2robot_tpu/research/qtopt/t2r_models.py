@@ -28,6 +28,7 @@ import numpy as np
 
 from tensor2robot_tpu import modes
 from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.layers.vision_layers import normalize_image
 from tensor2robot_tpu.models.critic_model import CriticModel
 from tensor2robot_tpu.preprocessors.image_preprocessors import (
     ImagePreprocessor,
@@ -51,7 +52,7 @@ class _GraspingQModule(nn.Module):
     norm = lambda name: nn.BatchNorm(
         use_running_average=not train, dtype=dtype, name=name)
 
-    x = features["image"].astype(dtype)
+    x = normalize_image(features["image"], dtype)
     # Stem: 472 -> 118 -> 59.
     x = nn.relu(norm("stem_bn")(nn.Conv(
         64, (6, 6), strides=(4, 4), dtype=dtype, name="stem")(x)))
@@ -99,21 +100,28 @@ class QTOptGraspingModel(CriticModel):
                action_size: int = ACTION_SIZE,
                state_size: int = 0,
                distort: bool = False,
+               uint8_images: bool = False,
                **kwargs):
     """state_size > 0 adds a proprioceptive `state` vector feature
-    (gripper status etc., reference's non-image state)."""
+    (gripper status etc., reference's non-image state).
+
+    uint8_images keeps camera images uint8 all the way to the device
+    (the cast + 1/255 rescale runs on-chip, fused into the stem conv):
+    4x less host→device and robot→predictor bandwidth for identical
+    math. Changes the serving signature — robots send uint8."""
     super().__init__(**kwargs)
     self._image_size = image_size
     self._in_image_size = in_image_size or image_size
     self._action_size = action_size
     self._state_size = state_size
     self._distort = distort
+    self._image_dtype = np.uint8 if uint8_images else np.float32
 
   def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
     del mode
     spec = ts.TensorSpecStruct({
         "image": ts.ExtendedTensorSpec(
-            (self._image_size, self._image_size, 3), np.float32,
+            (self._image_size, self._image_size, 3), self._image_dtype,
             name="image"),
         "action": ts.ExtendedTensorSpec(
             (self._action_size,), np.float32, name="action"),

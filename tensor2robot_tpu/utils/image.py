@@ -42,7 +42,10 @@ def decode_image(data: bytes) -> np.ndarray:
   return _decode(data)
 
 
-def _to_uint8(array: np.ndarray) -> np.ndarray:
+def to_uint8(array: np.ndarray) -> np.ndarray:
+  """Canonical image quantization: uint8 passthrough, integer clip,
+  [0,1]-float scale+round — the ONE rounding convention shared by the
+  encode helpers and the preprocessor's uint8 wire format."""
   array = np.asarray(array)
   if array.dtype == np.uint8:
     return array
@@ -59,7 +62,7 @@ def encode_jpeg(array: np.ndarray, quality: int = 95) -> bytes:
   pil = _pil()
   if pil is None:
     raise RuntimeError("JPEG encode requires PIL.")
-  array = _to_uint8(array)
+  array = to_uint8(array)
   if array.ndim == 3 and array.shape[-1] == 1:
     array = array[..., 0]
   buf = io.BytesIO()
@@ -73,7 +76,7 @@ def encode_png(array: np.ndarray) -> Optional[bytes]:
   pil = _pil()
   if pil is None:
     return None
-  array = _to_uint8(array)
+  array = to_uint8(array)
   if array.ndim == 3 and array.shape[-1] == 1:
     array = array[..., 0]
   buf = io.BytesIO()

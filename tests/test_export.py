@@ -235,3 +235,51 @@ print("SAVEDMODEL-OK")
         timeout=420)
     assert "SAVEDMODEL-OK" in result.stdout, (
         f"stdout={result.stdout}\nstderr={result.stderr[-3000:]}")
+
+  def test_savedmodel_uint8_raw_bytes_signature_subprocess(self, tmp_path):
+    """uint8-wire model: tf.io.parse_example can't parse uint8, so the
+    tf_example signature must take the raw-bytes tensor convention
+    (array.tobytes()) and decode_raw it — exercised end to end."""
+    script = f"""
+import os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax, numpy as np
+from tensor2robot_tpu.export.savedmodel_export_generator import (
+    SavedModelExportGenerator)
+from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
+
+model = QTOptGraspingModel(image_size=32, uint8_images=True)
+variables = jax.device_get(
+    model.init_variables(jax.random.key(0), batch_size=2))
+gen = SavedModelExportGenerator(export_root={str(tmp_path / "sm")!r},
+                                platforms=("cpu",))
+gen.set_specification_from_model(model)
+export_dir = gen.export(variables)
+
+import tensorflow as tf
+loaded = tf.saved_model.load(export_dir)
+rng = np.random.default_rng(0)
+image = rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)
+action = rng.standard_normal((4,)).astype(np.float32)
+ex = tf.train.Example(features=tf.train.Features(feature={{
+    "image": tf.train.Feature(bytes_list=tf.train.BytesList(
+        value=[image.tobytes()])),
+    "action": tf.train.Feature(float_list=tf.train.FloatList(
+        value=action.tolist()))}}))
+out = loaded.signatures["tf_example"](
+    tf.constant([ex.SerializeToString()]))
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+expected = model.predict_fn(variables, ts.TensorSpecStruct(
+    {{"image": image[None], "action": action[None]}}))
+np.testing.assert_allclose(
+    out["q_predicted"].numpy(), np.asarray(expected["q_predicted"]),
+    atol=1e-4)
+print("UINT8-SAVEDMODEL-OK")
+"""
+    result = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=420)
+    assert "UINT8-SAVEDMODEL-OK" in result.stdout, (
+        f"stdout={result.stdout}\nstderr={result.stderr[-3000:]}")

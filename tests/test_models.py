@@ -379,7 +379,29 @@ np.savez("{d}/tf_out.npz", contrast=contrast,
     out_eval2, _ = pre.preprocess(batch, None, modes.EVAL)
     np.testing.assert_array_equal(out_eval["image"], out_eval2["image"])
 
-  def test_image_preprocessor_requires_float_out(self):
-    with pytest.raises(ValueError, match="float"):
+  def test_image_preprocessor_rejects_non_image_out_dtype(self):
+    with pytest.raises(ValueError, match="float or uint8"):
       ImagePreprocessor(
-          {"image": ExtendedTensorSpec((8, 8, 3), np.uint8, name="image")})
+          {"image": ExtendedTensorSpec((8, 8, 3), np.int32, name="image")})
+
+  def test_image_preprocessor_uint8_out(self):
+    """uint8 out spec: images stay uint8 end-to-end (device does the
+    cast+rescale), including the distorted train path rounding back."""
+    rng = np.random.default_rng(0)
+    batch = TensorSpecStruct({
+        "image": rng.integers(0, 255, (4, 10, 10, 3)).astype(np.uint8)})
+    for distort in (False, True):
+      pre = ImagePreprocessor(
+          {"image": ExtendedTensorSpec((8, 8, 3), np.uint8, name="image")},
+          in_image_shape=(10, 10, 3), distort=distort, seed=0)
+      for mode in (modes.TRAIN, modes.EVAL):
+        out, _ = pre.preprocess(
+            TensorSpecStruct(batch), None, mode)
+        assert out["image"].dtype == np.uint8
+        assert out["image"].shape == (4, 8, 8, 3)
+    # Undistorted eval path is a pure crop — bytes untouched.
+    pre = ImagePreprocessor(
+        {"image": ExtendedTensorSpec((10, 10, 3), np.uint8, name="image")},
+        distort=False)
+    out, _ = pre.preprocess(TensorSpecStruct(batch), None, modes.EVAL)
+    np.testing.assert_array_equal(out["image"], batch["image"])

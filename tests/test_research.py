@@ -153,6 +153,32 @@ class TestQTOpt:
     policy(np.zeros((64, 64, 3), np.float32))
     assert policy._device_control is control
 
+  def test_uint8_images_variant_matches_float(self):
+    """The bandwidth-saving uint8 wire format must compute the same Q
+    as host-scaled float32 of the same pixels (cast+1/255 on device)."""
+    import jax
+    f32_model = QTOptGraspingModel(image_size=32)
+    u8_model = QTOptGraspingModel(image_size=32, uint8_images=True)
+    assert (u8_model.get_feature_specification(modes.TRAIN)["image"].dtype
+            == np.uint8)
+    variables = jax.device_get(
+        f32_model.init_variables(jax.random.key(0), batch_size=2))
+    rng = np.random.default_rng(0)
+    pixels = rng.integers(0, 255, (2, 32, 32, 3)).astype(np.uint8)
+    action = rng.standard_normal((2, 4)).astype(np.float32)
+    out_u8 = u8_model.predict_fn(
+        variables, {"image": pixels, "action": action})
+    out_f32 = f32_model.predict_fn(
+        variables, {"image": pixels.astype(np.float32) / 255.0,
+                    "action": action})
+    np.testing.assert_allclose(
+        np.asarray(out_u8["q_predicted"], np.float32),
+        np.asarray(out_f32["q_predicted"], np.float32), atol=1e-2)
+    # And it trains through the fixture (full pipeline, uint8 wire).
+    T2RModelFixture().random_train(
+        QTOptGraspingModel(image_size=64, uint8_images=True),
+        max_train_steps=2)
+
   def test_cem_policy_device_path_matches_host_fallback(self):
     from tensor2robot_tpu.predictors.checkpoint_predictor import (
         CheckpointPredictor,
