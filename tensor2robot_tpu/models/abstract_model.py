@@ -204,6 +204,20 @@ class AbstractT2RModel(abc.ABC):
     metrics.setdefault("loss", loss)
     return metrics
 
+  def model_image_summaries_fn(
+      self,
+      variables: Variables,
+      features: ts.TensorSpecStruct,
+  ) -> Optional[Dict[str, Any]]:
+    """Optional eval-time image summaries: {tag: HWC/HW uint8 or [0,1]
+    float image} rendered from one eval batch (reference: tf.summary
+    image summaries through host_call — e.g. grasp2vec localization
+    heatmaps). Default None = no images. Called by the eval loop with
+    the (EMA) eval variables and the last eval batch; written via
+    MetricWriter.write_images."""
+    del variables, features
+    return None
+
   # --- optimizer (reference §create_optimizer / §create_train_op) ---------
 
   def create_optimizer(self) -> optax.GradientTransformation:

@@ -106,3 +106,33 @@ class Grasp2VecModel(AbstractT2RModel):
         outputs["inference_output"], outputs["outcome_embedding"],
         l2_reg=self._l2_reg)
     return loss, {"npairs": loss, "retrieval_accuracy": accuracy}
+
+  def model_image_summaries_fn(self, variables, features):
+    """Localization heatmap for the first eval example (reference
+    §add_heatmap_summary): where in the pre-grasp scene the outcome
+    object's embedding correlates."""
+    import jax
+    from tensor2robot_tpu.research.grasp2vec import visualization
+
+    def first_local(x):
+      # First host-LOCAL example: global eval batches are sharded
+      # across processes on multi-host meshes, and indexing a
+      # non-fully-addressable array (or forwarding the whole batch
+      # eagerly) would either crash or waste a full-batch 3-tower
+      # forward for one rendered example.
+      if hasattr(x, "addressable_shards"):
+        x = x.addressable_shards[0].data
+      return np.asarray(x)[:1]
+
+    first = ts.TensorSpecStruct(
+        (k, first_local(v)) for k, v in
+        ts.flatten_spec_structure(features).items())
+    variables = jax.device_get(variables)
+    outputs, _ = self.inference_network_fn(variables, first, modes.EVAL)
+    heat = visualization.embedding_heatmap(
+        outputs["scene_spatial"], outputs["outcome_embedding"])
+    return {
+        "grasp2vec_heatmap": visualization.heatmap_to_image(
+            np.asarray(heat[0])),
+        "grasp2vec_pre_image": first["pre_image"][0],
+    }

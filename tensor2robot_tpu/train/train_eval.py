@@ -148,8 +148,12 @@ def train_eval_model(
   def run_eval(state: TrainState) -> Dict[str, float]:
     if input_generator_eval is None:
       return {}
-    metrics = _evaluate(trainer, model, input_generator_eval, state,
-                        eval_steps, prefetch_depth)
+    metrics, images = _evaluate(trainer, model, input_generator_eval,
+                                state, eval_steps, prefetch_depth)
+    if metric_writer and images:
+      metric_writer.write_images(
+          int(state.step),
+          {f"eval/{k}": v for k, v in images.items()})
     _run_exporters_after_eval(exporters, state, metrics)
     return metrics
 
@@ -282,22 +286,35 @@ def _stack_batches(host_iter, iterations_per_loop: int, total_steps: int):
 
 
 def _evaluate(trainer, model, input_generator_eval, state,
-              eval_steps: int, prefetch_depth: int) -> Dict[str, float]:
+              eval_steps: int, prefetch_depth: int):
   """Averages eval metrics over eval_steps batches (shared by the
-  interleaved eval arm and the continuous evaluator)."""
+  interleaved eval arm and the continuous evaluator).
+
+  Returns (metrics, image_summaries): images from the model's optional
+  model_image_summaries_fn rendered on the last eval batch ({} when the
+  model declares none)."""
   input_generator_eval.set_specification_from_model(model, modes.EVAL)
   eval_iter = prefetch_to_device(
       input_generator_eval.create_dataset_fn(modes.EVAL)(),
       sharding=trainer.batch_sharding, depth=prefetch_depth)
   sums: Dict[str, float] = {}
   count = 0
+  last_features = None
   for _, batch in zip(range(eval_steps), eval_iter):
     features, labels = batch
     metrics = trainer.eval_step(state, features, labels)
     for key, value in metrics.items():
       sums[key] = sums.get(key, 0.0) + float(value)
     count += 1
-  return {key: value / max(count, 1) for key, value in sums.items()}
+    last_features = features
+  metrics = {key: value / max(count, 1) for key, value in sums.items()}
+  images = {}
+  if last_features is not None:
+    rendered = model.model_image_summaries_fn(
+        state.variables(use_ema=True), last_features)
+    if rendered:
+      images = dict(rendered)
+  return metrics, images
 
 
 @configurable
@@ -349,11 +366,14 @@ def continuous_eval_model(
       for step in pending:  # every checkpoint, oldest first — no holes
         last_new_checkpoint = time.monotonic()
         state = checkpoint_manager.restore(template, step=step)
-        metrics = _evaluate(trainer, model, input_generator_eval, state,
-                            eval_steps, prefetch_depth)
+        metrics, images = _evaluate(trainer, model, input_generator_eval,
+                                    state, eval_steps, prefetch_depth)
         results[step] = metrics
         metric_writer.write_scalars(
             step, {f"eval/{k}": v for k, v in metrics.items()})
+        if images:
+          metric_writer.write_images(
+              step, {f"eval/{k}": v for k, v in images.items()})
         _log.info("continuous eval @ step %d: %s", step, metrics)
         _run_exporters_after_eval(exporters, state, metrics)
         if stop_after_step and step >= stop_after_step:

@@ -67,6 +67,17 @@ class TestGrasp2Vec:
     image = visualization.heatmap_to_image(np.asarray(heatmap[0]))
     assert image.dtype == np.uint8
 
+  def test_model_image_summaries(self):
+    import jax
+    model = Grasp2VecModel(image_size=32, depth=18)
+    variables = model.init_variables(jax.random.key(0), batch_size=2)
+    rng = np.random.default_rng(0)
+    features = {k: rng.random((2, 32, 32, 3)).astype(np.float32)
+                for k in ("pre_image", "post_image", "goal_image")}
+    images = model.model_image_summaries_fn(variables, features)
+    assert set(images) == {"grasp2vec_heatmap", "grasp2vec_pre_image"}
+    assert images["grasp2vec_heatmap"].dtype == np.uint8
+
 
 class TestVRGripper:
 

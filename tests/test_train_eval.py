@@ -297,6 +297,36 @@ class TestTrainEval:
     with pytest.raises(KeyError):
       exporter2.after_eval(variables, 6, {"other": 0.0})
 
+  def test_eval_image_summaries_written(self, tmp_path):
+    from tensorboard.compat.proto import event_pb2
+    from tensor2robot_tpu.data.tfrecord import read_tfrecords
+
+    class ImageSummaryModel(MockT2RModel):
+      def model_image_summaries_fn(self, variables, features):
+        return {"probe": np.full((8, 8, 3), 128, np.uint8)}
+
+    model_dir = str(tmp_path / "run")
+    train_eval_model(
+        ImageSummaryModel(),
+        input_generator_train=DefaultRandomInputGenerator(
+            batch_size=8, seed=0),
+        input_generator_eval=DefaultRandomInputGenerator(
+            batch_size=8, seed=1),
+        max_train_steps=2,
+        eval_steps=1,
+        model_dir=model_dir,
+        log_every_steps=1,
+    )
+    event_files = [f for f in os.listdir(model_dir)
+                   if f.startswith("events.out.tfevents")]
+    assert event_files
+    image_tags = []
+    for record in read_tfrecords(os.path.join(model_dir, event_files[0])):
+      event = event_pb2.Event.FromString(record)
+      image_tags.extend(v.tag for v in event.summary.value
+                        if v.HasField("image"))
+    assert "eval/probe" in image_tags
+
   def test_fixture(self, tmp_path):
     fixture = T2RModelFixture()
     result = fixture.random_train(
