@@ -18,6 +18,8 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from tensor2robot_tpu.layers.vision_layers import normalize_image
+
 # depth -> (block sizes, bottleneck?)
 _CONFIGS = {
     18: ((2, 2, 2, 2), False),
@@ -110,7 +112,7 @@ class ResNet(nn.Module):
       raise ValueError("FiLM ResNet requires a context embedding.")
     block_sizes, bottleneck = _CONFIGS[self.depth]
 
-    x = images.astype(self.dtype)
+    x = normalize_image(images, self.dtype)  # uint8 wire → [0,1] on-chip
     x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
                 dtype=self.dtype, name="stem_conv")(x)
     x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,

@@ -68,7 +68,7 @@ class ImagesToFeatures(nn.Module):
       raise ValueError(
           f"filters ({len(self.filters)}) and strides "
           f"({len(self.strides)}) must have equal length.")
-    x = images.astype(self.dtype)
+    x = normalize_image(images, self.dtype)  # uint8 wire → [0,1] on-chip
     for i, (width, stride) in enumerate(zip(self.filters, self.strides)):
       x = nn.Conv(width, (3, 3), strides=(stride, stride),
                   dtype=self.dtype, name=f"conv{i}")(x)

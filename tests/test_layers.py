@@ -217,3 +217,23 @@ class TestMdn:
     params = self._params()
     s = mdn.sample(params, jax.random.key(0))
     assert s.shape == (4, 2)
+
+
+class TestUint8WireFormat:
+
+  def test_towers_accept_uint8_identically(self):
+    """ResNet and the conv tower must treat the uint8 wire format
+    exactly as host-scaled [0,1] float of the same pixels (the
+    on-device cast+rescale in normalize_image)."""
+    from tensor2robot_tpu.layers.resnet import ResNet
+    from tensor2robot_tpu.layers.vision_layers import ImagesToFeatures
+    rng = np.random.default_rng(0)
+    pixels = rng.integers(0, 255, (2, 32, 32, 3)).astype(np.uint8)
+    scaled = pixels.astype(np.float32) / 255.0
+    for module in (ResNet(depth=18), ImagesToFeatures()):
+      variables = module.init(jax.random.key(0), scaled)
+      out_u8 = jax.tree_util.tree_leaves(module.apply(variables, pixels))[0]
+      out_f32 = jax.tree_util.tree_leaves(module.apply(variables, scaled))[0]
+      np.testing.assert_allclose(
+          np.asarray(out_u8, np.float32), np.asarray(out_f32, np.float32),
+          atol=1e-2)
