@@ -19,6 +19,8 @@ from tensor2robot_tpu.export.native_export_generator import (
 from tensor2robot_tpu.export.savedmodel_export_generator import (
     SavedModelExportGenerator,
 )
+from tensor2robot_tpu.export import exporters  # noqa: F401 (registers
+# LatestExporter / BestExporter / create_default_exporters_fn)
 from tensor2robot_tpu.hooks.async_export_hook import AsyncExportHookBuilder
 from tensor2robot_tpu.utils import global_step_functions  # noqa: F401
 from tensor2robot_tpu.utils import optimizers  # noqa: F401 (registers)
