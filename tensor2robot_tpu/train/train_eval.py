@@ -40,6 +40,70 @@ from tensor2robot_tpu.utils.metric_writer import MetricWriter
 _log = logging.getLogger(__name__)
 
 
+class _PreemptionGuard:
+  """SIGTERM/SIGINT → finish the current loop iteration, checkpoint,
+  exit cleanly (TPU-pod preemption notice; the reference's only story
+  was losing everything since the last CheckpointSaverHook save).
+
+  Installed only on the main thread and only for the duration of the
+  train loop; prior handlers are restored on exit. Second signal falls
+  through to the previous handler (so a double Ctrl-C still kills)."""
+
+  def __init__(self, enabled: bool = True):
+    self._enabled = enabled
+    self.requested = False
+    self._previous = {}
+
+  def __enter__(self):
+    if not self._enabled:
+      return self
+    import signal
+    import threading
+    if threading.current_thread() is not threading.main_thread():
+      return self  # signal.signal is main-thread-only; run unguarded
+
+    def handler(signum, frame):
+      if self.requested:  # second signal: defer to the original handler
+        previous = self._previous.get(signum)
+        if callable(previous):
+          previous(signum, frame)
+          return
+        raise KeyboardInterrupt
+      self.requested = True
+      _log.warning(
+          "Signal %d received: checkpointing at the next loop boundary "
+          "and exiting.", signum)
+
+    for signum in (signal.SIGTERM, signal.SIGINT):
+      try:
+        self._previous[signum] = signal.signal(signum, handler)
+      except (ValueError, OSError):  # non-main interpreter contexts
+        pass
+    return self
+
+  def __exit__(self, *exc):
+    import signal
+    for signum, previous in self._previous.items():
+      signal.signal(signum, previous)
+    self._previous = {}
+    return False
+
+  def globally_requested(self) -> bool:
+    """Whether ANY host has seen a signal — collectively agreed, so
+    every host leaves the train loop at the SAME step boundary (a
+    lone host exiting early would deadlock the others' collectives).
+    Call at synchronized points only (all hosts, same step)."""
+    if jax.process_count() == 1:
+      return self.requested
+    from jax.experimental import multihost_utils
+    flag = multihost_utils.process_allgather(
+        np.asarray(1 if self.requested else 0, np.int32))
+    agreed = bool(np.max(flag))
+    if agreed:
+      self.requested = True
+    return agreed
+
+
 def _init_exporters(create_exporters_fn, model, model_dir: str):
   """Builds and binds eval-driven exporters; rejects root collisions."""
   if create_exporters_fn is None:
@@ -97,6 +161,7 @@ def train_eval_model(
     log_every_steps: int = 100,
     iterations_per_loop: int = 1,
     prefetch_depth: int = 2,
+    handle_preemption: bool = True,
 ) -> TrainEvalResult:
   """Trains (and optionally evaluates/exports) `model`.
 
@@ -107,6 +172,9 @@ def train_eval_model(
     eval_interval_steps: interleave eval every N train steps (0 = only a
       final eval if an eval generator is given).
     save_checkpoints_steps: checkpoint cadence (0 = only final).
+    handle_preemption: trap SIGTERM/SIGINT during the train loop and
+      exit through the normal final-checkpoint path at the next loop
+      boundary, so a preempted run resumes exactly where it stopped.
     export_generator: exported at end; pair with AsyncExportHookBuilder
       for continuous exports.
     create_exporters_fn: model -> [export.exporters.Exporter]; each runs
@@ -161,107 +229,131 @@ def train_eval_model(
     raise ValueError(f"iterations_per_loop must be >= 1, got "
                      f"{iterations_per_loop}")
 
-  if input_generator_train is not None and max_train_steps > 0:
-    input_generator_train.set_specification_from_model(model, modes.TRAIN)
-    host_iter = input_generator_train.create_dataset_fn(modes.TRAIN)()
-    start_step = int(state.step)
-    if iterations_per_loop > 1:
-      from tensor2robot_tpu.parallel import mesh as mesh_lib
-      train_iter = prefetch_to_device(
-          _stack_batches(host_iter, iterations_per_loop,
-                         max_train_steps - start_step),
-          sharding=mesh_lib.stacked_batch_sharding(
-              trainer.mesh, trainer.data_axis),
-          depth=prefetch_depth)
-    else:
-      train_iter = prefetch_to_device(
-          host_iter, sharding=trainer.batch_sharding, depth=prefetch_depth)
-
-    step = start_step
-    pending_metrics = None
-    # Bound async dispatch: a deep queue of un-synced steps buys nothing
-    # (the device is saturated after ~2) and on CPU-mesh test hosts it
-    # can starve XLA's in-process collective rendezvous.
-    import collections
-    max_inflight = max(2, prefetch_depth)
-    inflight = collections.deque()
-
-    def crossed(cadence: int, prev: int, now: int) -> bool:
-      return cadence > 0 and now // cadence > prev // cadence
-
-    while step < max_train_steps:
-      features, labels = next(train_iter)
+  # The guard stays armed through the final checkpoint + close():
+  # a signal landing during the save must not restore a default handler
+  # that kills the writer mid-file. Second signal still force-kills.
+  preemption = _PreemptionGuard(
+      enabled=(handle_preemption and input_generator_train is not None
+               and max_train_steps > 0))
+  preemption.__enter__()
+  single_host = jax.process_count() == 1
+  try:
+    if input_generator_train is not None and max_train_steps > 0:
+      input_generator_train.set_specification_from_model(model, modes.TRAIN)
+      host_iter = input_generator_train.create_dataset_fn(modes.TRAIN)()
+      start_step = int(state.step)
       if iterations_per_loop > 1:
-        state, pending_metrics = trainer.train_steps(state, features, labels)
-        advanced = jax.tree_util.tree_leaves(features)[0].shape[0]
+        from tensor2robot_tpu.parallel import mesh as mesh_lib
+        train_iter = prefetch_to_device(
+            _stack_batches(host_iter, iterations_per_loop,
+                           max_train_steps - start_step),
+            sharding=mesh_lib.stacked_batch_sharding(
+                trainer.mesh, trainer.data_axis),
+            depth=prefetch_depth)
       else:
-        state, pending_metrics = trainer.train_step(state, features, labels)
-        advanced = 1
-      prev_step, step = step, step + advanced
-      inflight.append(pending_metrics["loss"])
-      if len(inflight) > max_inflight:
-        inflight.popleft().block_until_ready()
+        train_iter = prefetch_to_device(
+            host_iter, sharding=trainer.batch_sharding, depth=prefetch_depth)
 
-      if crossed(log_every_steps, prev_step, step) or step == max_train_steps:
-        host_metrics = {k: float(v) for k, v in pending_metrics.items()}
-        train_metrics = host_metrics
-        if metric_writer:
-          metric_writer.write_scalars(step, host_metrics)
+      step = start_step
+      pending_metrics = None
+      # Bound async dispatch: a deep queue of un-synced steps buys nothing
+      # (the device is saturated after ~2) and on CPU-mesh test hosts it
+      # can starve XLA's in-process collective rendezvous.
+      import collections
+      max_inflight = max(2, prefetch_depth)
+      inflight = collections.deque()
+
+      def crossed(cadence: int, prev: int, now: int) -> bool:
+        return cadence > 0 and now // cadence > prev // cadence
+
+      while step < max_train_steps and not (single_host
+                                            and preemption.requested):
+        features, labels = next(train_iter)
+        if iterations_per_loop > 1:
+          state, pending_metrics = trainer.train_steps(state, features, labels)
+          advanced = jax.tree_util.tree_leaves(features)[0].shape[0]
+        else:
+          state, pending_metrics = trainer.train_step(state, features, labels)
+          advanced = 1
+        prev_step, step = step, step + advanced
+        inflight.append(pending_metrics["loss"])
+        if len(inflight) > max_inflight:
+          inflight.popleft().block_until_ready()
+
+        if crossed(log_every_steps, prev_step, step) or step == max_train_steps:
+          host_metrics = {k: float(v) for k, v in pending_metrics.items()}
+          train_metrics = host_metrics
+          if metric_writer:
+            metric_writer.write_scalars(step, host_metrics)
+          for hook in hooks:
+            hook.after_step(state, host_metrics)
+          _log.info("step %d: %s", step, host_metrics)
+
+        # Multi-host preemption agreement: every host reaches this sync
+        # boundary at the same step, so the collective decision makes all
+        # hosts leave the loop together (a lone early exit would deadlock
+        # the others' all-reduces).
+        if not single_host and crossed(log_every_steps, prev_step, step):
+          if preemption.globally_requested():
+            break
+
+        if checkpoint_manager and checkpoint_manager.should_save(
+            step, last_step=prev_step):
+          checkpoint_manager.save(step, state)
+          for hook in hooks:
+            hook.after_checkpoint(step, state)
+
+        if (crossed(eval_interval_steps, prev_step, step)
+            and step < max_train_steps):
+          eval_metrics = run_eval(state)
+          if metric_writer and eval_metrics:
+            metric_writer.write_scalars(
+                step, {f"eval/{k}": v for k, v in eval_metrics.items()})
+      if preemption.requested:
+        _log.warning("Preempted at step %d; final checkpoint below is the "
+                     "resume point.", step)
+
+    # Final checkpoint (also the resume point for a follow-on run).
+    if checkpoint_manager:
+      final_step = int(state.step)
+      if checkpoint_manager.latest_step() != final_step:
+        checkpoint_manager.save(final_step, state, force=True)
         for hook in hooks:
-          hook.after_step(state, host_metrics)
-        _log.info("step %d: %s", step, host_metrics)
+          hook.after_checkpoint(final_step, state)
 
-      if checkpoint_manager and checkpoint_manager.should_save(
-          step, last_step=prev_step):
-        checkpoint_manager.save(step, state)
-        for hook in hooks:
-          hook.after_checkpoint(step, state)
+    final_eval = run_eval(state)
+    if final_eval:
+      eval_metrics = final_eval
+      if metric_writer:
+        metric_writer.write_scalars(
+            int(state.step), {f"eval/{k}": v for k, v in eval_metrics.items()})
 
-      if (crossed(eval_interval_steps, prev_step, step)
-          and step < max_train_steps):
-        eval_metrics = run_eval(state)
-        if metric_writer and eval_metrics:
-          metric_writer.write_scalars(
-              step, {f"eval/{k}": v for k, v in eval_metrics.items()})
+    if export_generator is not None:
+      from tensor2robot_tpu.export import export_utils
+      export_utils.resolve_export_root(export_generator, model_dir)
+      if any(os.path.abspath(e.export_root)
+             == os.path.abspath(export_generator.export_root)
+             for e in exporters):
+        raise ValueError(
+            f"export_generator and an eval exporter both publish to "
+            f"{export_generator.export_root!r}; their GC policies would "
+            "delete each other's versions. Give the exporter a different "
+            "name or drop one of the two.")
+      export_generator.set_specification_from_model(model)
+      export_dir = export_utils.export_and_gc(
+          export_generator, jax.device_get(state.variables(use_ema=True)),
+          keep=export_keep, global_step=int(state.step))
+      _log.info("Exported final model to %s", export_dir)
 
-  # Final checkpoint (also the resume point for a follow-on run).
-  if checkpoint_manager:
-    final_step = int(state.step)
-    if checkpoint_manager.latest_step() != final_step:
-      checkpoint_manager.save(final_step, state, force=True)
-      for hook in hooks:
-        hook.after_checkpoint(final_step, state)
-
-  final_eval = run_eval(state)
-  if final_eval:
-    eval_metrics = final_eval
+    for hook in hooks:
+      hook.end(state)
+    if checkpoint_manager:
+      checkpoint_manager.close()
     if metric_writer:
-      metric_writer.write_scalars(
-          int(state.step), {f"eval/{k}": v for k, v in eval_metrics.items()})
+      metric_writer.close()
 
-  if export_generator is not None:
-    from tensor2robot_tpu.export import export_utils
-    export_utils.resolve_export_root(export_generator, model_dir)
-    if any(os.path.abspath(e.export_root)
-           == os.path.abspath(export_generator.export_root)
-           for e in exporters):
-      raise ValueError(
-          f"export_generator and an eval exporter both publish to "
-          f"{export_generator.export_root!r}; their GC policies would "
-          "delete each other's versions. Give the exporter a different "
-          "name or drop one of the two.")
-    export_generator.set_specification_from_model(model)
-    export_dir = export_utils.export_and_gc(
-        export_generator, jax.device_get(state.variables(use_ema=True)),
-        keep=export_keep, global_step=int(state.step))
-    _log.info("Exported final model to %s", export_dir)
-
-  for hook in hooks:
-    hook.end(state)
-  if checkpoint_manager:
-    checkpoint_manager.close()
-  if metric_writer:
-    metric_writer.close()
+  finally:
+    preemption.__exit__()
 
   return TrainEvalResult(
       state=state,

@@ -118,6 +118,23 @@ total = jax.jit(
 # Sum over the GLOBAL batch 0..3 => 6: the cross-process all-reduce ran.
 assert float(total) == 6.0, float(total)
 
+
+# The REAL train loop under multi-host: per-host input pipelines feed
+# global sharded batches, and the preemption-agreement collective at
+# the log boundary must not desynchronize the hosts.
+from tensor2robot_tpu.data.default_input_generator import (
+    DefaultRandomInputGenerator)
+from tensor2robot_tpu.train.train_eval import train_eval_model
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+result = train_eval_model(
+    MockT2RModel(),
+    input_generator_train=DefaultRandomInputGenerator(batch_size=4, seed=0),
+    max_train_steps=4,
+    log_every_steps=2,
+)
+assert int(result.state.step) == 4, int(result.state.step)
+
 distributed.sync_global_devices("test_done")
 print(f"WORKER{process_id}_OK primary={distributed.is_primary()}")
 """
