@@ -99,6 +99,32 @@ def resolve_export_root(generator, model_dir: Optional[str]) -> None:
     generator.export_root = os.path.join(model_dir, "export", "latest")
 
 
+def fetch_variables_to_host(variables):
+  """Device variables → host numpy, safely for ANY sharding.
+
+  Replicated / single-host-sharded leaves are a plain device_get;
+  leaves sharded across processes (TP on a multi-host mesh) are
+  all-gathered first — device_get on a non-fully-addressable array
+  raises. Every exporter path (end-of-train, eval exporters, the async
+  hook) must fetch through this."""
+  import jax
+  import numpy as np
+
+  def fetch(leaf):
+    # Only genuinely cross-process-SHARDED leaves need the all-gather;
+    # fully-replicated multi-host arrays (the pure-DP default) fetch
+    # locally with a plain device_get (every process holds a full copy).
+    if (hasattr(leaf, "is_fully_addressable")
+        and not leaf.is_fully_addressable
+        and not getattr(leaf, "is_fully_replicated", False)):
+      from jax.experimental import multihost_utils
+      return np.asarray(multihost_utils.process_allgather(leaf,
+                                                          tiled=True))
+    return jax.device_get(leaf)
+
+  return jax.tree_util.tree_map(fetch, variables)
+
+
 def export_and_gc(generator, variables, keep: int,
                   global_step: int = 0) -> str:
   """One export + version GC (the publish step both export paths share)."""

@@ -4,7 +4,7 @@ Reference parity: hooks/async_export_hook_builder.py (SURVEY.md §3.4) —
 TPU training can't export inline, so a checkpoint-triggered listener
 exports in a worker thread and GCs old versions, keeping the robot
 fleet's poll directory fresh during long runs. Same design here: the
-device never stalls on export — the hook snapshots (device_get) the EMA
+device never stalls on export — the hook snapshots (host fetch) the EMA
 variables at a checkpoint boundary and hands them to a single worker
 thread; if an export is still running the new request replaces any
 queued one (exporting every checkpoint is pointless if exports are
@@ -18,8 +18,6 @@ import queue
 import threading
 import time
 from typing import List, Optional
-
-import jax
 
 from tensor2robot_tpu.export import export_utils
 from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder
@@ -63,7 +61,8 @@ class AsyncExportHook(Hook):
   def after_checkpoint(self, step: int, state) -> None:
     # Snapshot on the host: the donated device buffers are reused by the
     # next step, so the worker must not touch them.
-    variables = jax.device_get(state.variables(use_ema=True))
+    variables = export_utils.fetch_variables_to_host(
+        state.variables(use_ema=True))
     self._submit((variables, int(state.step)))
     self._last_submitted_step = int(state.step)
 
@@ -94,7 +93,8 @@ class AsyncExportHook(Hook):
     deadline = time.monotonic() + self._shutdown_timeout_s
     submitted = True
     if self._last_submitted_step != int(state.step):
-      variables = jax.device_get(state.variables(use_ema=True))
+      variables = export_utils.fetch_variables_to_host(
+          state.variables(use_ema=True))
       submitted = self._put_with_deadline((variables, int(state.step)),
                                           deadline)
     if submitted and self._put_with_deadline(self._stop, deadline):

@@ -111,7 +111,6 @@ class Grasp2VecModel(AbstractT2RModel):
     """Localization heatmap for the first eval example (reference
     §add_heatmap_summary): where in the pre-grasp scene the outcome
     object's embedding correlates."""
-    import jax
     from tensor2robot_tpu.research.grasp2vec import visualization
 
     def first_local(x):
@@ -127,7 +126,8 @@ class Grasp2VecModel(AbstractT2RModel):
     first = ts.TensorSpecStruct(
         (k, first_local(v)) for k, v in
         ts.flatten_spec_structure(features).items())
-    variables = jax.device_get(variables)
+    from tensor2robot_tpu.export import export_utils
+    variables = export_utils.fetch_variables_to_host(variables)
     outputs, _ = self.inference_network_fn(variables, first, modes.EVAL)
     heat = visualization.embedding_heatmap(
         outputs["scene_spatial"], outputs["outcome_embedding"])

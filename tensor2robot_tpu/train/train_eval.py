@@ -127,9 +127,11 @@ def _run_exporters_after_eval(exporters, state, eval_metrics) -> None:
   if not exporters:
     return
   from tensor2robot_tpu.export.exporters import run_exporters
+  from tensor2robot_tpu.export import export_utils
   run_exporters(
       exporters,
-      lambda: jax.device_get(state.variables(use_ema=True)),
+      lambda: export_utils.fetch_variables_to_host(
+          state.variables(use_ema=True)),
       int(state.step), eval_metrics)
 
 
@@ -162,6 +164,8 @@ def train_eval_model(
     iterations_per_loop: int = 1,
     prefetch_depth: int = 2,
     handle_preemption: bool = True,
+    param_specs=None,
+    shard_optimizer_state: bool = False,
 ) -> TrainEvalResult:
   """Trains (and optionally evaluates/exports) `model`.
 
@@ -183,8 +187,12 @@ def train_eval_model(
     iterations_per_loop: steps fused into one compiled lax.scan dispatch
       (TPUConfig(iterations_per_loop)). Logging/checkpoint/eval cadences
       then fire at the first loop boundary that crosses their multiple.
+    param_specs: tensor-parallel parameter shardings (see
+      Trainer/parallel.tp_rules); None = replicated params.
+    shard_optimizer_state: ZeRO-1 weight-update sharding (see Trainer).
   """
-  trainer = Trainer(model, mesh=mesh, seed=seed)
+  trainer = Trainer(model, mesh=mesh, seed=seed, param_specs=param_specs,
+                    shard_optimizer_state=shard_optimizer_state)
   state = trainer.create_train_state()
 
   checkpoint_manager = None
@@ -341,7 +349,9 @@ def train_eval_model(
             "name or drop one of the two.")
       export_generator.set_specification_from_model(model)
       export_dir = export_utils.export_and_gc(
-          export_generator, jax.device_get(state.variables(use_ema=True)),
+          export_generator,
+          export_utils.fetch_variables_to_host(
+              state.variables(use_ema=True)),
           keep=export_keep, global_step=int(state.step))
       _log.info("Exported final model to %s", export_dir)
 
@@ -423,6 +433,8 @@ def continuous_eval_model(
     mesh=None,
     seed: int = 0,
     prefetch_depth: int = 2,
+    param_specs=None,
+    shard_optimizer_state: bool = False,
 ) -> Dict[int, Dict[str, float]]:
   """Separate-job evaluator: evaluate every checkpoint as it lands.
 
@@ -439,7 +451,8 @@ def continuous_eval_model(
 
   Returns {checkpoint_step: eval metrics} for every evaluated step.
   """
-  trainer = Trainer(model, mesh=mesh, seed=seed)
+  trainer = Trainer(model, mesh=mesh, seed=seed, param_specs=param_specs,
+                    shard_optimizer_state=shard_optimizer_state)
   template = trainer.create_train_state()
   checkpoint_manager = CheckpointManager(
       os.path.join(model_dir, "checkpoints"))

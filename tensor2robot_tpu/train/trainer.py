@@ -296,7 +296,10 @@ class Trainer:
     baked-in constants — keeps the executable weight-free."""
     # Host snapshot: the state's device buffers are donated to the next
     # train_step and would be invalidated under the closure's feet.
-    variables = jax.device_get(state.variables(use_ema=True))
+    # Multihost-safe fetch: TP params may be sharded across processes.
+    from tensor2robot_tpu.export import export_utils
+    variables = export_utils.fetch_variables_to_host(
+        state.variables(use_ema=True))
     model = self.model
     jitted = jax.jit(model.predict_fn)
 
