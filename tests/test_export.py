@@ -283,3 +283,23 @@ print("UINT8-SAVEDMODEL-OK")
         timeout=420)
     assert "UINT8-SAVEDMODEL-OK" in result.stdout, (
         f"stdout={result.stdout}\nstderr={result.stderr[-3000:]}")
+
+
+class TestFetchVariablesToHost:
+
+  def test_replicated_and_sharded_leaves(self):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+    from tensor2robot_tpu.parallel import create_mesh
+
+    mesh = create_mesh({"data": -1})
+    replicated = jax.device_put(
+        jnp.arange(16.0), NamedSharding(mesh, PartitionSpec()))
+    sharded = jax.device_put(
+        jnp.arange(16.0), NamedSharding(mesh, PartitionSpec("data")))
+    out = export_utils.fetch_variables_to_host(
+        {"r": replicated, "s": sharded, "scalar": jnp.float32(3.0)})
+    np.testing.assert_array_equal(out["r"], np.arange(16.0))
+    np.testing.assert_array_equal(out["s"], np.arange(16.0))
+    assert float(out["scalar"]) == 3.0
