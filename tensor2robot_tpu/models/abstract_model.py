@@ -56,6 +56,7 @@ class AbstractT2RModel(abc.ABC):
       use_avg_model_params: bool = False,
       avg_model_params_decay: float = 0.9999,
       init_from_checkpoint: Optional[str] = None,
+      init_from_checkpoint_assignment_map: Optional[Dict[str, str]] = None,
       compute_dtype: Any = jnp.bfloat16,
       param_dtype: Any = jnp.float32,
   ):
@@ -69,6 +70,10 @@ class AbstractT2RModel(abc.ABC):
       avg_model_params_decay: EMA decay.
       init_from_checkpoint: checkpoint path to warm-start from (reference
         §init_from_checkpoint); applied by the trainer before step 0.
+      init_from_checkpoint_assignment_map: optional {source_prefix:
+        target_prefix} param renaming for the warm-start, in
+        tf.train.init_from_checkpoint's direction — checkpoint name on
+        the left (see train.checkpoints.merge_params).
       compute_dtype: activation dtype inside the network (bfloat16 keeps
         matmuls on the MXU's native path).
       param_dtype: master parameter dtype.
@@ -77,6 +82,8 @@ class AbstractT2RModel(abc.ABC):
     self.use_avg_model_params = use_avg_model_params
     self.avg_model_params_decay = avg_model_params_decay
     self.init_from_checkpoint = init_from_checkpoint
+    self.init_from_checkpoint_assignment_map = (
+        init_from_checkpoint_assignment_map)
     self.compute_dtype = compute_dtype
     self.param_dtype = param_dtype
     self._module: Optional[nn.Module] = None

@@ -104,23 +104,68 @@ def restore_params(checkpoint_path: str) -> Any:
   return restored["params"]
 
 
-def merge_params(target: Any, restored: Any) -> Any:
+def _slash_key(path) -> str:
+  """Pytree key path → readable 'a/b/c' (module/param naming)."""
+  parts = []
+  for entry in path:
+    if hasattr(entry, "key"):
+      parts.append(str(entry.key))
+    elif hasattr(entry, "idx"):
+      parts.append(str(entry.idx))
+    else:
+      parts.append(str(entry))
+  return "/".join(parts)
+
+
+def merge_params(target: Any, restored: Any,
+                 assignment_map: Optional[dict] = None) -> Any:
   """Copies into `target` every leaf whose path and shape match `restored`.
 
-  Reference parity: init_from_checkpoint's variable filtering — warm-start
-  a subset (e.g. a conv tower) into a larger model without requiring a
-  full match.
+  Reference parity: init_from_checkpoint's variable filtering AND
+  renaming — warm-start a subset (e.g. a conv tower) into a larger
+  model, optionally under a different module name.
+
+  Args:
+    assignment_map: {source_prefix: target_prefix} over slash-joined
+      param paths, in tf.train.init_from_checkpoint's direction —
+      checkpoint name on the left, current-model name on the right
+      (e.g. {"conv_tower": "scene_tower"} loads checkpoint leaves under
+      conv_tower/... into the model's scene_tower/...). Longest
+      matching target prefix wins; unmapped paths look up their own
+      name. An entry that copies zero leaves logs a warning — a typo'd
+      rename must not silently leave random init in place.
   """
+  import logging
   flat_restored = {
-      jax.tree_util.keystr(path): leaf
+      _slash_key(path): leaf
       for path, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]
   }
+  # Match against the TARGET side (map values), rewrite to the source.
+  by_target = sorted(((t, s) for s, t in (assignment_map or {}).items()),
+                     key=lambda kv: len(kv[0]), reverse=True)
+  copied_per_entry = {source: 0 for source in (assignment_map or {})}
 
   def _pick(path, leaf):
-    key = jax.tree_util.keystr(path)
-    candidate = flat_restored.get(key)
+    key = _slash_key(path)
+    lookup = key
+    entry = None
+    for target_prefix, source_prefix in by_target:
+      if key == target_prefix or key.startswith(target_prefix + "/"):
+        lookup = source_prefix + key[len(target_prefix):]
+        entry = source_prefix
+        break
+    candidate = flat_restored.get(lookup)
     if candidate is not None and np.shape(candidate) == np.shape(leaf):
+      if entry is not None:
+        copied_per_entry[entry] += 1
       return jax.numpy.asarray(candidate, dtype=leaf.dtype)
     return leaf
 
-  return jax.tree_util.tree_map_with_path(_pick, target)
+  merged = jax.tree_util.tree_map_with_path(_pick, target)
+  for source, count in copied_per_entry.items():
+    if count == 0:
+      logging.getLogger(__name__).warning(
+          "assignment_map entry %r -> %r copied ZERO leaves — check the "
+          "prefixes against the checkpoint and model param names.",
+          source, (assignment_map or {}).get(source))
+  return merged

@@ -151,13 +151,21 @@ class Trainer:
     """Reference §init_from_checkpoint: load matching params by name."""
     from tensor2robot_tpu.train import checkpoints
     restored = checkpoints.restore_params(checkpoint_path)
-    params = checkpoints.merge_params(state.params, restored)
+    params = checkpoints.merge_params(
+        state.params, restored,
+        assignment_map=self.model.init_from_checkpoint_assignment_map)
     if self.param_specs is None:
       params = jax.device_put(params, self._replicated)
     else:
       params = jax.device_put(
           params, tp_rules.specs_to_shardings(self.param_specs, self.mesh))
-    return state.replace(params=params)
+    # EMA re-seeds from the warm-started params: at decay ~0.9999 an
+    # EMA left on the random init would poison eval/export for tens of
+    # thousands of steps.
+    ema = state.ema_params
+    if ema is not None:
+      ema = jax.tree_util.tree_map(jnp.copy, params)
+    return state.replace(params=params, ema_params=ema)
 
   # --- steps ---------------------------------------------------------------
 

@@ -307,6 +307,72 @@ class TestCheckpoints:
             np.asarray(a), np.asarray(b)),
         jax.device_get(warm_state.params), restored)
 
+  def test_merge_params_assignment_map_renames(self):
+    # Reference assignment_map: load checkpoint subtree conv_tower/*
+    # into the model's scene_tower/* (shape-guarded as usual).
+    restored = {"conv_tower": {"kernel": np.ones((2, 2)),
+                               "bias": np.ones((3,))},
+                "head": {"w": np.full((4,), 7.0)}}
+    target = {"scene_tower": {"kernel": jnp.zeros((2, 2)),
+                              "bias": jnp.zeros((2,))},  # shape mismatch
+              "head": {"w": jnp.zeros((4,))}}
+    merged = merge_params(target, restored,
+                          assignment_map={"conv_tower": "scene_tower"})
+    np.testing.assert_array_equal(
+        np.asarray(merged["scene_tower"]["kernel"]), np.ones((2, 2)))
+    # Mismatched shape under the renamed prefix keeps the target init.
+    np.testing.assert_array_equal(
+        np.asarray(merged["scene_tower"]["bias"]), np.zeros((2,)))
+    # Unmapped paths still match by their own name.
+    np.testing.assert_array_equal(
+        np.asarray(merged["head"]["w"]), np.full((4,), 7.0))
+
+  def test_warm_start_with_assignment_map(self, tmp_path):
+    # Save a checkpoint whose params live under a LEGACY layer name,
+    # then warm-start the current model by mapping its layer onto the
+    # legacy one — the model→trainer assignment-map plumbing end to end.
+    model = MockT2RModel()
+    trainer = Trainer(model, seed=5)
+    state = trainer.create_train_state()
+    features, labels = _make_batch(trainer, model)
+    state, _ = trainer.train_step(state, features, labels)
+    legacy_params = dict(jax.device_get(state.params))
+    legacy_params["legacy_dense"] = legacy_params.pop("Dense_0")
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    manager.save(int(state.step), state.replace(params=legacy_params))
+    manager.close()
+
+    warm_model = MockT2RModel(
+        init_from_checkpoint=str(tmp_path / "ckpt"),
+        init_from_checkpoint_assignment_map={"legacy_dense": "Dense_0"})
+    warm_trainer = Trainer(warm_model, seed=99)
+    warm_state = warm_trainer.create_train_state()
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6),
+        jax.device_get(warm_state.params), jax.device_get(state.params))
+
+  def test_warm_start_reseeds_ema(self, tmp_path):
+    model = MockT2RModel()
+    trainer = Trainer(model, seed=5)
+    state = trainer.create_train_state()
+    features, labels = _make_batch(trainer, model)
+    state, _ = trainer.train_step(state, features, labels)
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    manager.save(int(state.step), state)
+    manager.close()
+
+    warm_model = MockT2RModel(use_avg_model_params=True,
+                              init_from_checkpoint=str(tmp_path / "ckpt"))
+    warm_state = Trainer(warm_model, seed=99).create_train_state()
+    # EMA starts at the warm-started params, not the random init: at
+    # decay ~0.9999 a stale EMA would poison eval/export for ages.
+    jax.tree_util.tree_map(
+        lambda e, p: np.testing.assert_array_equal(
+            np.asarray(e), np.asarray(p)),
+        jax.device_get(warm_state.ema_params),
+        jax.device_get(warm_state.params))
+
   def test_merge_params_skips_mismatched(self):
     target = {"a": jnp.zeros((2,)), "b": jnp.zeros((3,))}
     restored = {"a": np.ones((2,)), "b": np.ones((4,)), "c": np.ones(1)}
