@@ -179,6 +179,36 @@ class TestQTOpt:
         QTOptGraspingModel(image_size=64, uint8_images=True),
         max_train_steps=2)
 
+  def test_cem_policy_rebuilds_on_hot_reload(self, tmp_path):
+    """A robot's predictor hot-reloads newer exports mid-mission; the
+    fused control step must rebuild for the new model version."""
+    import jax
+    from tensor2robot_tpu.export import NativeExportGenerator, export_utils
+    from tensor2robot_tpu.predictors.exported_model_predictor import (
+        ExportedModelPredictor,
+    )
+    model = QTOptGraspingModel(image_size=32)
+    root = str(tmp_path / "export")
+    gen = NativeExportGenerator(export_root=root)
+    gen.set_specification_from_model(model)
+    v1 = jax.device_get(model.init_variables(jax.random.key(1),
+                                             batch_size=4))
+    export_utils.export_and_gc(gen, v1, keep=3, global_step=1)
+    predictor = ExportedModelPredictor(root)
+    assert predictor.restore()
+    policy = cem.CEMPolicy(predictor, action_size=4, num_samples=8,
+                           iterations=1, seed=0)
+    image = np.random.default_rng(0).random((32, 32, 3)).astype(np.float32)
+    policy(image)
+    first_control = policy._device_control
+    v2 = jax.device_get(model.init_variables(jax.random.key(2),
+                                             batch_size=4))
+    export_utils.export_and_gc(gen, v2, keep=3, global_step=2)
+    assert predictor.restore()  # hot reload
+    policy(image)
+    assert policy._device_control is not first_control
+    assert policy._device_version == predictor.model_version
+
   def test_cem_policy_device_path_matches_host_fallback(self):
     from tensor2robot_tpu.predictors.checkpoint_predictor import (
         CheckpointPredictor,
