@@ -100,6 +100,7 @@ class ResNet(nn.Module):
   num_classes: int = 0
   film: bool = False
   return_spatial: bool = False  # also return the pre-pool feature map
+  remat: bool = False  # rematerialize each block on the backward pass
   dtype: Any = jnp.bfloat16
 
   @nn.compact
@@ -120,9 +121,16 @@ class ResNet(nn.Module):
     x = nn.relu(x)
     x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 
+    # remat=True drops each block's activations after the forward pass and
+    # recomputes them during backprop (jax.checkpoint): activation memory
+    # goes from O(depth) to O(1) blocks — the HBM-for-FLOPs trade that
+    # lets deep towers train at large batch/resolution on one chip.
+    # (self, x, context, train) → train is static arg index 3.
+    block_cls = (nn.remat(_Block, static_argnums=(3,)) if self.remat
+                 else _Block)
     for stage, num_blocks in enumerate(block_sizes):
       for block in range(num_blocks):
-        x = _Block(
+        x = block_cls(
             width=self.width * (2 ** stage),
             stride=2 if (block == 0 and stage > 0) else 1,
             bottleneck=bottleneck,

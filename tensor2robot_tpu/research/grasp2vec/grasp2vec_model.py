@@ -34,14 +34,16 @@ class _Grasp2VecModule(nn.Module):
 
   depth: int = 50
   embedding_size: int = EMBEDDING_SIZE
+  remat: bool = False
   compute_dtype: Any = jnp.bfloat16
 
   @nn.compact
   def __call__(self, features, mode: str):
     train = mode == modes.TRAIN
     scene_tower = ResNet(depth=self.depth, return_spatial=True,
+                         remat=self.remat,
                          dtype=self.compute_dtype, name="scene_tower")
-    outcome_tower = ResNet(depth=self.depth,
+    outcome_tower = ResNet(depth=self.depth, remat=self.remat,
                            dtype=self.compute_dtype, name="outcome_tower")
     project = nn.Dense(self.embedding_size, dtype=jnp.float32,
                        name="scene_proj")
@@ -72,12 +74,17 @@ class Grasp2VecModel(AbstractT2RModel):
 
   def __init__(self, image_size: int = IMAGE_SIZE, depth: int = 50,
                embedding_size: int = EMBEDDING_SIZE,
-               l2_reg: float = 2e-3, **kwargs):
+               l2_reg: float = 2e-3, remat: bool = False, **kwargs):
+    """remat: rematerialize residual blocks on backprop — 3 ResNet-50
+    towers at 224×224 are the framework's most activation-hungry
+    workload; remat trades ~33% more FLOPs for O(1)-block activation
+    memory, buying larger per-chip batches (see layers.resnet.ResNet)."""
     super().__init__(**kwargs)
     self._image_size = image_size
     self._depth = depth
     self._embedding_size = embedding_size
     self._l2_reg = l2_reg
+    self._remat = remat
 
   def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
     del mode
@@ -97,6 +104,7 @@ class Grasp2VecModel(AbstractT2RModel):
     return _Grasp2VecModule(
         depth=self._depth,
         embedding_size=self._embedding_size,
+        remat=self._remat,
         compute_dtype=self.compute_dtype)
 
   def loss_fn(self, outputs, features, labels

@@ -162,6 +162,7 @@ def train_eval_model(
     seed: int = 0,
     log_every_steps: int = 100,
     iterations_per_loop: int = 1,
+    gradient_accumulation_steps: int = 1,
     prefetch_depth: int = 2,
     handle_preemption: bool = True,
     param_specs=None,
@@ -187,6 +188,11 @@ def train_eval_model(
     iterations_per_loop: steps fused into one compiled lax.scan dispatch
       (TPUConfig(iterations_per_loop)). Logging/checkpoint/eval cadences
       then fire at the first loop boundary that crosses their multiple.
+    gradient_accumulation_steps: microbatches averaged into each
+      optimizer step (Trainer.train_step_accum): effective batch =
+      K × batch_size in one microbatch's activation memory. Each global
+      step then consumes K generator batches. Mutually exclusive with
+      iterations_per_loop > 1.
     param_specs: tensor-parallel parameter shardings (see
       Trainer/parallel.tp_rules); None = replicated params.
     shard_optimizer_state: ZeRO-1 weight-update sharding (see Trainer).
@@ -236,6 +242,14 @@ def train_eval_model(
   if iterations_per_loop < 1:
     raise ValueError(f"iterations_per_loop must be >= 1, got "
                      f"{iterations_per_loop}")
+  if gradient_accumulation_steps < 1:
+    raise ValueError(f"gradient_accumulation_steps must be >= 1, got "
+                     f"{gradient_accumulation_steps}")
+  if gradient_accumulation_steps > 1 and iterations_per_loop > 1:
+    raise ValueError(
+        "gradient_accumulation_steps and iterations_per_loop are mutually "
+        "exclusive: one trades memory for compute, the other fuses "
+        "dispatches — accumulate inside a scanned loop is not supported.")
 
   # The guard stays armed through the final checkpoint + close():
   # a signal landing during the save must not restore a default handler
@@ -250,11 +264,21 @@ def train_eval_model(
       input_generator_train.set_specification_from_model(model, modes.TRAIN)
       host_iter = input_generator_train.create_dataset_fn(modes.TRAIN)()
       start_step = int(state.step)
-      if iterations_per_loop > 1:
+      if iterations_per_loop > 1 or gradient_accumulation_steps > 1:
+        # Both modes feed (K, batch, ...) stacks; they differ only in K
+        # and in how many generator batches one global step consumes:
+        # scan advances K steps per stack, accumulation folds K
+        # microbatches into one step (so total batches = steps × K, and
+        # every stack is full-K — one compiled executable).
         from tensor2robot_tpu.parallel import mesh as mesh_lib
+        if iterations_per_loop > 1:
+          stack_size, total = (iterations_per_loop,
+                               max_train_steps - start_step)
+        else:
+          stack_size = gradient_accumulation_steps
+          total = (max_train_steps - start_step) * stack_size
         train_iter = prefetch_to_device(
-            _stack_batches(host_iter, iterations_per_loop,
-                           max_train_steps - start_step),
+            _stack_batches(host_iter, stack_size, total),
             sharding=mesh_lib.stacked_batch_sharding(
                 trainer.mesh, trainer.data_axis),
             depth=prefetch_depth)
@@ -280,6 +304,10 @@ def train_eval_model(
         if iterations_per_loop > 1:
           state, pending_metrics = trainer.train_steps(state, features, labels)
           advanced = jax.tree_util.tree_leaves(features)[0].shape[0]
+        elif gradient_accumulation_steps > 1:
+          state, pending_metrics = trainer.train_step_accum(
+              state, features, labels)
+          advanced = 1
         else:
           state, pending_metrics = trainer.train_step(state, features, labels)
           advanced = 1

@@ -81,6 +81,7 @@ class Trainer:
     self._replicated = mesh_lib.replicated_sharding(self.mesh)
     self._train_step = None
     self._train_steps = None
+    self._train_step_accum = None
     self._eval_step = None
 
   def _constrain_params(self, params):
@@ -169,11 +170,32 @@ class Trainer:
 
   # --- steps ---------------------------------------------------------------
 
+  def _apply_grads(self, state: TrainState, grads, new_model_state
+                   ) -> TrainState:
+    """Optimizer update + EMA + step bump, shared by the single-step and
+    gradient-accumulation bodies (the reference's §create_train_op
+    apply_gradients half)."""
+    updates, new_opt_state = self._optimizer.update(
+        grads, state.opt_state, state.params)
+    new_opt_state = self._constrain_opt_state(new_opt_state)
+    new_params = self._constrain_params(
+        optax.apply_updates(state.params, updates))
+    new_ema = state.ema_params
+    if new_ema is not None:
+      new_ema = optax.incremental_update(
+          new_params, new_ema,
+          step_size=1.0 - self.model.avg_model_params_decay)
+    return state.replace(
+        step=state.step + 1,
+        params=new_params,
+        model_state=new_model_state,
+        opt_state=new_opt_state,
+        ema_params=new_ema)
+
   def _make_train_step_fn(self):
     """The uncompiled (state, features, labels) -> (state', metrics) body
     shared by the single-step and scanned multi-step compilations."""
     model = self.model
-    optimizer = self._optimizer
     base_rng = self._base_rng
 
     def step_fn(state: TrainState, features, labels
@@ -188,25 +210,53 @@ class Trainer:
 
       grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
       (_, (metrics, new_model_state)), grads = grad_fn(state.params)
-      updates, new_opt_state = optimizer.update(
-          grads, state.opt_state, state.params)
-      new_opt_state = self._constrain_opt_state(new_opt_state)
-      new_params = self._constrain_params(
-          optax.apply_updates(state.params, updates))
-      new_ema = state.ema_params
-      if new_ema is not None:
-        new_ema = optax.incremental_update(
-            new_params, new_ema,
-            step_size=1.0 - model.avg_model_params_decay)
-      new_state = state.replace(
-          step=state.step + 1,
-          params=new_params,
-          model_state=new_model_state,
-          opt_state=new_opt_state,
-          ema_params=new_ema)
-      return new_state, metrics
+      return self._apply_grads(state, grads, new_model_state), metrics
 
     return step_fn
+
+  def _make_train_step_accum_fn(self):
+    """One optimizer step over K sequential microbatches (leading axis on
+    every leaf): gradients are averaged across microbatches before a
+    single apply, so the effective batch is K× what fits in HBM at once
+    — the memory-bound complement to `train_steps`' scan. Mutable model
+    state (batch_stats) threads through the microbatches sequentially;
+    metrics are microbatch means. RNG folds (step, microbatch index), so
+    each microbatch draws distinct dropout."""
+    model = self.model
+    base_rng = self._base_rng
+
+    def accum_fn(state: TrainState, features, labels
+                 ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+      rng = jax.random.fold_in(base_rng, state.step)
+      num_micro = jax.tree_util.tree_leaves(features)[0].shape[0]
+
+      def loss_fn(params, model_state, feat, lab, micro_rng):
+        variables = {"params": params, **model_state}
+        loss, (metrics, new_model_state) = model.model_train_fn(
+            variables, feat, lab, rngs={"dropout": micro_rng})
+        return loss, (metrics, new_model_state)
+
+      grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+      def body(carry, xs):
+        acc, model_state, idx = carry
+        feat, lab = xs
+        micro_rng = jax.random.fold_in(rng, idx)
+        (_, (metrics, new_model_state)), grads = grad_fn(
+            state.params, model_state, feat, lab, micro_rng)
+        acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+        return (acc, new_model_state, idx + 1), metrics
+
+      zero = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+      (acc, new_model_state, _), metrics = jax.lax.scan(
+          body, (zero, state.model_state, jnp.zeros((), jnp.int32)),
+          (features, labels))
+      grads = jax.tree_util.tree_map(lambda g: g / num_micro, acc)
+      metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0),
+                                       metrics)
+      return self._apply_grads(state, grads, new_model_state), metrics
+
+    return accum_fn
 
   def _build_train_step(self):
     step_fn = self._make_train_step_fn()
@@ -247,6 +297,17 @@ class Trainer:
           donate_argnums=(0,))
     return jax.jit(many_fn, donate_argnums=(0,))
 
+  def _build_train_step_accum(self):
+    accum_fn = self._make_train_step_accum_fn()
+    if self._pure_dp:
+      stacked = mesh_lib.stacked_batch_sharding(self.mesh, self.data_axis)
+      return jax.jit(
+          accum_fn,
+          in_shardings=(self._replicated, stacked, stacked),
+          out_shardings=(self._replicated, self._replicated),
+          donate_argnums=(0,))
+    return jax.jit(accum_fn, donate_argnums=(0,))
+
   def _build_eval_step(self):
     model = self.model
 
@@ -281,6 +342,15 @@ class Trainer:
     if self._train_steps is None:
       self._train_steps = self._build_train_steps()
     return self._train_steps(state, features, labels)
+
+  def train_step_accum(self, state: TrainState, features, labels=None
+                       ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+    """One optimizer step over K stacked microbatches (leading axis on
+    every leaf): grads averaged, single apply — K× effective batch in
+    O(1-microbatch) activation memory. Donates `state`."""
+    if self._train_step_accum is None:
+      self._train_step_accum = self._build_train_step_accum()
+    return self._train_step_accum(state, features, labels)
 
   def eval_step(self, state: TrainState, features, labels=None
                 ) -> Dict[str, jnp.ndarray]:

@@ -94,6 +94,35 @@ class TestResNet:
         variables["batch_stats"], new_state["batch_stats"])
     assert any(jax.tree_util.tree_leaves(changed))
 
+  def test_remat_matches_dense_forward_and_grads(self):
+    """remat=True must be a pure memory/FLOPs trade: same params, same
+    outputs, same gradients as the dense tower."""
+    images = jnp.asarray(
+        np.random.default_rng(0).uniform(size=(2, 32, 32, 3)), jnp.float32)
+    dense = ResNet(depth=18, width=16, dtype=jnp.float32)
+    remat = ResNet(depth=18, width=16, dtype=jnp.float32, remat=True)
+    variables = dense.init(jax.random.key(0), images)
+    # Identical parameter structure: remat wraps the blocks, it must not
+    # rename or reshape anything.
+    remat_variables = remat.init(jax.random.key(0), images)
+    assert (jax.tree_util.tree_structure(variables)
+            == jax.tree_util.tree_structure(remat_variables))
+
+    def loss(module, params):
+      out = module.apply({**variables, "params": params}, images)
+      return jnp.sum(out ** 2)
+
+    out_d = dense.apply(variables, images)
+    out_r = remat.apply(variables, images)
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_r),
+                               atol=1e-6)
+    g_d = jax.grad(lambda p: loss(dense, p))(variables["params"])
+    g_r = jax.grad(lambda p: loss(remat, p))(variables["params"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5),
+        g_d, g_r)
+
 
 class TestSnail:
 

@@ -161,6 +161,88 @@ class TestTrainer:
     np.testing.assert_allclose(float(m1["loss"]), float(m3["loss"]))
 
 
+class TestGradientAccumulation:
+
+  def test_accum_matches_one_big_batch(self):
+    """K averaged microbatch grads ≡ one grad of the concatenated batch
+    (mean losses), so SGD params after train_step_accum must match a
+    single train_step on the full batch. Deterministic model (no
+    dropout, float32 compute) so the equivalence is exact."""
+    import flax.linen as nn
+    from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+    class _DeterministicModule(nn.Module):
+      @nn.compact
+      def __call__(self, features, mode):
+        del mode
+        x = nn.Dense(16)(features["x"])
+        out = nn.Dense(1)(nn.relu(x))
+        return ts.TensorSpecStruct({"inference_output": out})
+
+    class _DeterministicModel(MockT2RModel):
+      def build_module(self):
+        return _DeterministicModule()
+
+    import optax
+
+    def fresh():
+      model = _DeterministicModel(optimizer_fn=lambda: optax.sgd(1e-2),
+                                  compute_dtype=jnp.float32)
+      trainer = Trainer(model, seed=5)
+      return model, trainer, trainer.create_train_state()
+
+    model, trainer, state = fresh()
+    features, labels = _make_batch(trainer, model, batch_size=16, seed=7)
+    full = jax.device_get((features, labels))
+
+    # Same data as two stacked microbatches of 8.
+    split = jax.tree_util.tree_map(
+        lambda x: x.reshape((2, 8) + x.shape[1:]), full)
+    stacked_sharding = mesh_lib.stacked_batch_sharding(
+        trainer.mesh, trainer.data_axis)
+    micro_f, micro_l = jax.device_put(split, stacked_sharding)
+
+    state_accum, metrics_accum = trainer.train_step_accum(
+        state, micro_f, micro_l)
+    assert int(state_accum.step) == 1
+
+    _, trainer2, state2 = fresh()
+    state_full, metrics_full = trainer2.train_step(
+        state2, *trainer2.shard_batch(full))
+
+    np.testing.assert_allclose(
+        float(metrics_accum["loss"]), float(metrics_full["loss"]),
+        rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+        jax.device_get(state_accum.params),
+        jax.device_get(state_full.params))
+
+  def test_train_eval_accumulation_path(self, tmp_path):
+    from tensor2robot_tpu.train.train_eval import train_eval_model
+    model = MockT2RModel()
+    result = train_eval_model(
+        model,
+        input_generator_train=DefaultRandomInputGenerator(
+            batch_size=8, seed=0),
+        max_train_steps=3,
+        gradient_accumulation_steps=2,
+        model_dir=os.fspath(tmp_path),
+        log_every_steps=1)
+    assert int(result.state.step) == 3
+
+  def test_rejects_scan_combination(self):
+    from tensor2robot_tpu.train.train_eval import train_eval_model
+    with pytest.raises(ValueError, match="mutually"):
+      train_eval_model(
+          MockT2RModel(),
+          input_generator_train=DefaultRandomInputGenerator(
+              batch_size=8, seed=0),
+          max_train_steps=2,
+          iterations_per_loop=2,
+          gradient_accumulation_steps=2)
+
+
 class TestShardedOptimizerState:
 
   def test_matches_replicated_and_actually_shards(self):
