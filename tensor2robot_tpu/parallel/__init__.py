@@ -34,6 +34,8 @@ from tensor2robot_tpu.parallel.expert_parallel import (
 from tensor2robot_tpu.parallel.tp_rules import (
     infer_dense_tp_specs,
     infer_dense_tp_specs_from_model,
+    infer_fsdp_specs,
+    infer_fsdp_specs_from_model,
     specs_to_shardings,
 )
 
@@ -54,5 +56,7 @@ __all__ = [
     "switch_moe",
     "infer_dense_tp_specs",
     "infer_dense_tp_specs_from_model",
+    "infer_fsdp_specs",
+    "infer_fsdp_specs_from_model",
     "specs_to_shardings",
 ]

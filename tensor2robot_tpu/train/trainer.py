@@ -48,9 +48,10 @@ class Trainer:
   ):
     """Args:
       param_specs: optional PartitionSpec pytree (or prefix) for params —
-        tensor parallelism over extra mesh axes (see
-        parallel.tp_rules.infer_dense_tp_specs). None = replicated
-        params, pure DP (the reference's only strategy).
+        tensor parallelism over extra mesh axes
+        (parallel.tp_rules.infer_dense_tp_specs) or FSDP/ZeRO-3 over the
+        data axis (infer_fsdp_specs). None = replicated params, pure DP
+        (the reference's only strategy).
       shard_optimizer_state: ZeRO-1-style cross-replica weight-update
         sharding (Xu et al. 2020, arXiv:2004.13336): optimizer-state
         leaves are partitioned over the data axis (largest divisible
@@ -66,9 +67,10 @@ class Trainer:
     self.param_specs = param_specs
     if shard_optimizer_state and param_specs is not None:
       raise ValueError(
-          "shard_optimizer_state composes with pure DP only; under "
-          "param_specs (TP) the optimizer state already follows the "
-          "parameter shardings.")
+          "shard_optimizer_state composes with pure DP only: under "
+          "param_specs the optimizer state already follows the parameter "
+          "shardings (TP shards it over the model axis; FSDP over the "
+          "data axis).")
     self._shard_opt = shard_optimizer_state
     # Pure DP = every TrainState leaf replicated, so the jits can pin
     # explicit in/out shardings; any other mode (TP, sharded opt state)
@@ -99,24 +101,19 @@ class Trainer:
 
   def _constrain_opt_state(self, opt_state):
     """Pins optimizer-state leaves to data-axis shardings (ZeRO-1):
-    each leaf shards its largest data-axis-divisible dim; scalars and
-    indivisible leaves stay replicated."""
+    each leaf shards its largest data-axis-divisible dim (the same rule
+    FSDP applies to params); scalars and indivisible leaves stay
+    replicated."""
     if not self._shard_opt:
       return opt_state
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import NamedSharding
     axis_size = self.mesh.shape[self.data_axis]
 
     def constrain(leaf):
-      shape = getattr(leaf, "shape", ())
-      divisible = [i for i, s in enumerate(shape)
-                   if s >= axis_size and s % axis_size == 0]
-      if not divisible:
-        return jax.lax.with_sharding_constraint(leaf, self._replicated)
-      dim = max(divisible, key=lambda i: shape[i])
-      spec = [None] * len(shape)
-      spec[dim] = self.data_axis
+      spec = tp_rules.largest_divisible_dim_spec(
+          getattr(leaf, "shape", ()), self.data_axis, axis_size)
       return jax.lax.with_sharding_constraint(
-          leaf, NamedSharding(self.mesh, PartitionSpec(*spec)))
+          leaf, NamedSharding(self.mesh, spec))
 
     return jax.tree_util.tree_map(constrain, opt_state)
 
