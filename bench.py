@@ -32,6 +32,13 @@ MEASURE_LOOPS = 3
 # Throughput plateaus around K=60 on the v5e chip (measured 175 → 200 →
 # 220 steps/s at K=1/20/60); the K-deep stacked batch (~5 GB at batch
 # 32 float32) fits comfortably in 16 GB HBM.
+# Roofline (measured 2026-07-30 via compiled.cost_analysis): 95 GF and
+# 4.03 GB of HBM traffic per step → at ~4.8 ms/step the chip moves
+# ~840 GB/s, saturating v5e HBM bandwidth (~819 GB/s spec) at ~10% MXU.
+# The big 472×472 conv tower is bandwidth-bound (BN train-mode stats
+# force extra activation passes XLA can't fuse away), so steps/sec here
+# is at the hardware ceiling for this architecture; further gains would
+# require semantic changes (smaller activations, norm-free tower).
 ITERATIONS_PER_LOOP = 60
 
 
