@@ -1,8 +1,13 @@
 """pose_env: the minimal end-to-end demo task (SURVEY.md §2, BASELINE #1)."""
 
+from tensor2robot_tpu.research.pose_env.eval_policy import (
+    evaluate_policy,
+    oracle_policy,
+)
 from tensor2robot_tpu.research.pose_env.pose_env import PoseEnv, PoseToyEnv
 from tensor2robot_tpu.research.pose_env.pose_env_models import (
     PoseEnvRegressionModel,
 )
 
-__all__ = ["PoseEnv", "PoseToyEnv", "PoseEnvRegressionModel"]
+__all__ = ["PoseEnv", "PoseToyEnv", "PoseEnvRegressionModel",
+           "evaluate_policy", "oracle_policy"]

@@ -1,0 +1,88 @@
+"""Policy evaluation in the pose environment: rollout success rate.
+
+Reference parity: the reference's pose_env demo measured a trained
+policy by stepping the (PyBullet) env with model predictions and
+counting reaches within the success threshold (research/pose_env
+§PoseEnv usage in its tests/demo main; SURVEY.md §2, §6 "grasp-success
+parity" is the same metric shape for qtopt, whose grasping env lives
+outside the repo). This is the serving-side complement to train-time
+eval: it drives any predictor — exported SavedModel, native artifact,
+checkpoint predictor, or a plain callable — through the real
+observation → predict → act loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Union
+
+import numpy as np
+
+from tensor2robot_tpu.research.pose_env.pose_env import IMAGE_SIZE, PoseEnv
+
+# Anything with .predict(features) -> outputs, or the bare callable.
+Policy = Union[Callable[[Mapping[str, np.ndarray]], Mapping[str, Any]], Any]
+
+
+def evaluate_policy(
+    policy: Policy,
+    num_episodes: int = 50,
+    seed: int = 0,
+    image_size: int = IMAGE_SIZE,
+    success_threshold: float = 0.1,
+    output_key: str = "inference_output",
+) -> Dict[str, float]:
+  """Rolls a policy in PoseEnv; returns success rate + mean reward.
+
+  Args:
+    policy: an AbstractPredictor (its ``predict`` is used) or a callable
+      mapping a batched feature dict ``{"image": float32 [1, S, S, 3] in
+      [0, 1]}`` to an output mapping with ``output_key`` -> [1, 2] pose.
+    num_episodes: episodes to roll (each is one reach).
+    seed: env seed (targets are placed deterministically given it).
+    image_size: rendered camera size; must match the policy's spec.
+    success_threshold: reach distance counted as success (env default).
+    output_key: key of the predicted pose in the policy's outputs.
+
+  Returns:
+    {"success_rate", "mean_reward", "num_episodes"}.
+  """
+  predict = policy.predict if hasattr(policy, "predict") else policy
+  env = PoseEnv(image_size=image_size, seed=seed,
+                success_threshold=success_threshold)
+  successes = 0
+  rewards = []
+  for _ in range(num_episodes):
+    obs = env.reset()
+    features = {"image": obs["image"].astype(np.float32)[None] / 255.0}
+    outputs = predict(features)
+    action = np.asarray(outputs[output_key], np.float32)[0]
+    if action.shape != (2,):
+      raise ValueError(
+          f"Policy output {output_key!r} must be a [1, 2] pose; got "
+          f"shape {np.asarray(outputs[output_key]).shape}.")
+    step = env.step(action)
+    successes += bool(step.info["success"])
+    rewards.append(step.reward)
+  return {
+      "success_rate": successes / num_episodes,
+      "mean_reward": float(np.mean(rewards)),
+      "num_episodes": float(num_episodes),
+  }
+
+
+def oracle_policy(features: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:
+  """Perfect vision-based policy: localizes the red target disc in the
+  image (centroid of target-colored pixels) and reaches for it. Used to
+  validate the evaluation harness end-to-end — it must score ~100%."""
+  from tensor2robot_tpu.research.pose_env.pose_env import TARGET_COLOR
+  image = np.asarray(features["image"])[0]  # [S, S, 3] in [0, 1]
+  s = image.shape[0]
+  target = np.asarray(TARGET_COLOR, np.float32) / 255.0
+  dist = np.linalg.norm(image - target, axis=-1)
+  mask = dist < 0.05
+  if not mask.any():
+    return {"inference_output": np.zeros((1, 2), np.float32)}
+  yy, xx = np.nonzero(mask)
+  from tensor2robot_tpu.research.pose_env.pose_env import pixel_to_pose
+  x, y = pixel_to_pose((float(xx.mean()), float(yy.mean())), s)
+  return {"inference_output": np.asarray([[x, y]], np.float32)}

@@ -97,12 +97,25 @@ class PoseEnv:
   def _draw_disc(self, image: np.ndarray, center_xy: Tuple[float, float],
                  radius: float, color) -> None:
     s = self._image_size
-    cx = (center_xy[0] + 1.0) / 2.0 * (s - 1)
-    cy = (1.0 - (center_xy[1] + 1.0) / 2.0) * (s - 1)
+    cx, cy = pose_to_pixel(center_xy, s)
     r = radius / 2.0 * (s - 1)
     yy, xx = np.mgrid[0:s, 0:s]
     mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= r ** 2
     image[mask] = color
+
+
+def pose_to_pixel(pose_xy, image_size: int) -> Tuple[float, float]:
+  """Table coords [-1, 1]² → pixel (x, y); the rasterization mapping."""
+  px = (pose_xy[0] + 1.0) / 2.0 * (image_size - 1)
+  py = (1.0 - (pose_xy[1] + 1.0) / 2.0) * (image_size - 1)
+  return px, py
+
+
+def pixel_to_pose(pixel_xy, image_size: int) -> Tuple[float, float]:
+  """Pixel (x, y) → table coords; exact inverse of pose_to_pixel."""
+  x = pixel_xy[0] / (image_size - 1) * 2.0 - 1.0
+  y = 1.0 - pixel_xy[1] / (image_size - 1) * 2.0
+  return x, y
 
 
 # Reference alias (SURVEY.md names both).

@@ -13,6 +13,10 @@ from tensor2robot_tpu.data.default_input_generator import (
     DefaultRecordInputGenerator,
 )
 from tensor2robot_tpu.research.pose_env import pose_env
+from tensor2robot_tpu.research.pose_env.eval_policy import (
+    evaluate_policy,
+    oracle_policy,
+)
 from tensor2robot_tpu.research.pose_env.pose_env_models import (
     PoseEnvRegressionModel,
 )
@@ -43,10 +47,30 @@ class TestPoseEnv:
     env = pose_env.PoseEnv(seed=0)
     env.reset()
     image = env.render()
-    tx, ty = env.target_pose
-    px = int(round((tx + 1) / 2 * 63))
-    py = int(round((1 - (ty + 1) / 2) * 63))
-    assert tuple(image[py, px]) == pose_env.TARGET_COLOR
+    px, py = pose_env.pose_to_pixel(env.target_pose, 64)
+    assert tuple(image[int(round(py)), int(round(px))]) == (
+        pose_env.TARGET_COLOR)
+
+  def test_evaluate_policy_oracle_vs_random(self):
+    """The rollout harness: a perfect vision policy scores ~100%, a
+    random one ~the disc-area base rate — validating success counting,
+    observation plumbing, and the rasterizer inverse."""
+    oracle = evaluate_policy(oracle_policy, num_episodes=30, seed=11)
+    assert oracle["success_rate"] >= 0.95
+    assert oracle["mean_reward"] > -0.05
+    assert oracle["num_episodes"] == 30
+
+    rng = np.random.default_rng(5)
+    random_policy = lambda f: {
+        "inference_output": rng.uniform(-1, 1, (1, 2)).astype(np.float32)}
+    rand = evaluate_policy(random_policy, num_episodes=30, seed=11)
+    assert rand["success_rate"] < 0.2
+    assert rand["mean_reward"] < oracle["mean_reward"]
+
+  def test_evaluate_policy_rejects_bad_output_shape(self):
+    bad = lambda f: {"inference_output": np.zeros((1, 3), np.float32)}
+    with pytest.raises(ValueError, match="pose"):
+      evaluate_policy(bad, num_episodes=1)
 
   def test_tfrecord_round_trip_and_training(self, tmp_path):
     """The §7.6 slice: collect → TFRecord (jpeg) → parse → train → export
