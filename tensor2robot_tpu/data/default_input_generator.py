@@ -86,12 +86,20 @@ def _pipelined_parse(
     finally:
       stop.set()
       # Unblock a reader stuck between put attempts and let the pool die.
-      while True:
-        try:
+      # Exception, not queue.Empty: when an ABANDONED iterator is
+      # finalized at interpreter shutdown, module globals (ours and the
+      # stdlib's) may already be cleared, and even queue.get_nowait's
+      # internal `raise Empty` then fails with TypeError. Both drains are
+      # best-effort; the daemon threads cannot outlive the process.
+      try:
+        while True:
           futures.get_nowait()
-        except queue.Empty:
-          break
-      pool.shutdown(wait=False, cancel_futures=True)
+      except Exception:
+        pass
+      try:
+        pool.shutdown(wait=False, cancel_futures=True)
+      except Exception:
+        pass
 
   return iterator()
 

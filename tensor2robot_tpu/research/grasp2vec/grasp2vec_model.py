@@ -9,7 +9,7 @@ BASELINE config #2.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -19,9 +19,6 @@ from tensor2robot_tpu import modes
 from tensor2robot_tpu.config import configurable
 from tensor2robot_tpu.layers.resnet import ResNet
 from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, Metrics
-from tensor2robot_tpu.preprocessors.image_preprocessors import (
-    ImagePreprocessor,
-)
 from tensor2robot_tpu.research.grasp2vec import losses
 from tensor2robot_tpu.specs import tensorspec_utils as ts
 

@@ -20,7 +20,7 @@ TPU design notes:
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -8,7 +8,7 @@ built on MAMLModel. BASELINE config #5.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -18,7 +18,7 @@ from tensor2robot_tpu import modes
 from tensor2robot_tpu.config import configurable
 from tensor2robot_tpu.layers import mdn
 from tensor2robot_tpu.layers.resnet import ResNet
-from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, Metrics
+from tensor2robot_tpu.models.abstract_model import Metrics
 from tensor2robot_tpu.models.regression_model import RegressionModel
 from tensor2robot_tpu.specs import tensorspec_utils as ts
 

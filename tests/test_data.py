@@ -396,3 +396,44 @@ class TestPrefetch:
     out = list(prefetch_to_device(iter(batches), sharding=sharding))
     assert out[0].sharding == sharding
     np.testing.assert_array_equal(np.asarray(out[0]), batches[0])
+
+
+class TestIteratorShutdown:
+
+  @pytest.mark.parametrize("disable_native", ["0", "1"])
+  def test_abandoned_live_iterator_exits_cleanly(self, tmp_path,
+                                                 disable_native):
+    """An iterator abandoned mid-stream must not traceback when the
+    interpreter exits (generator finalization runs after module globals
+    are cleared — regression test for the queue.Empty-at-shutdown bug)."""
+    import subprocess
+    import sys
+    script = f"""
+import numpy as np
+from tensor2robot_tpu.data.tfrecord import TFRecordWriter
+from tensor2robot_tpu.data.example_proto import encode_example
+from tensor2robot_tpu.data.default_input_generator import (
+    DefaultRecordInputGenerator)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+from tensor2robot_tpu import modes
+
+path = {str(tmp_path / "t.tfrecord")!r}
+with TFRecordWriter(path) as w:
+  for i in range(64):
+    w.write(encode_example({{"x": np.full((3,), i, np.float32)}}))
+spec = ts.TensorSpecStruct(
+    {{"x": ts.ExtendedTensorSpec((3,), np.float32, name="x")}})
+gen = DefaultRecordInputGenerator(file_patterns=path, batch_size=4, seed=1)
+gen.set_specification(feature_spec=spec)
+it = gen.create_dataset_fn(modes.TRAIN)()
+next(it)
+print("abandoned")
+"""
+    env = dict(os.environ)
+    env["T2R_DISABLE_NATIVE"] = disable_native
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    result = subprocess.run([sys.executable, "-c", script], env=env,
+                            capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stderr
+    assert "abandoned" in result.stdout
+    assert "Traceback" not in result.stderr, result.stderr
