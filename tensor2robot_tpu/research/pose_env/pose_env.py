@@ -142,21 +142,16 @@ def write_tfrecords(path: str, num_episodes: int, seed: int = 0,
                     image_size: int = IMAGE_SIZE) -> str:
   """Collects episodes and writes the reference-format TFRecord file:
   tf.Examples with a jpeg-encoded image and a float target pose."""
-  import io
-
-  from PIL import Image
-
   from tensor2robot_tpu.data import example_proto, tfrecord
+  from tensor2robot_tpu.utils.image import encode_jpeg
 
   images, poses = collect_episodes(num_episodes, seed=seed,
                                    image_size=image_size)
 
   def records():
     for image, pose in zip(images, poses):
-      buf = io.BytesIO()
-      Image.fromarray(image).save(buf, format="JPEG", quality=95)
       yield example_proto.encode_example({
-          "image": [buf.getvalue()],
+          "image": [encode_jpeg(image)],
           "target_pose": pose.tolist(),
       })
 

@@ -121,6 +121,45 @@ class TestPoseEnv:
 
 class TestQTOpt:
 
+  def test_synthetic_grasping_closed_loop(self, tmp_path):
+    """The grasp-success capability claim in miniature (SURVEY §6 /
+    BASELINE "grasp-success parity"): train the Q-fn on logged random
+    grasps through the real record pipeline, serve through the real CEM
+    policy, and closed-loop success must clearly beat random grasping."""
+    from tensor2robot_tpu.predictors.checkpoint_predictor import (
+        CheckpointPredictor)
+    from tensor2robot_tpu.research.qtopt import synthetic_grasping as sg
+
+    radius = 0.4  # generous: at 32px the action-merge map is 4×4 coarse
+    rec = str(tmp_path / "grasps.tfrecord")
+    sg.write_tfrecords(rec, num_examples=1024, image_size=32, seed=0,
+                       radius=radius)
+    model = QTOptGraspingModel(image_size=32, in_image_size=32,
+                               optimizer_fn=lambda: optax.adam(2e-3))
+    gen = DefaultRecordInputGenerator(file_patterns=rec, batch_size=64,
+                                      seed=1)
+    md = str(tmp_path / "run")
+    train_eval_model(model, input_generator_train=gen,
+                     max_train_steps=300, iterations_per_loop=50,
+                     model_dir=md, log_every_steps=300)
+
+    predictor = CheckpointPredictor(model, os.path.join(md, "checkpoints"))
+    assert predictor.restore()
+    policy = cem.CEMPolicy(predictor, action_size=4, num_samples=64,
+                           num_elites=6, iterations=3, seed=7)
+    trained = sg.evaluate_grasp_policy(policy, num_scenes=30, seed=999,
+                                       image_size=32, radius=radius)
+    rng = np.random.default_rng(0)
+    random_r = sg.evaluate_grasp_policy(
+        lambda im: rng.uniform(-1, 1, 4), num_scenes=30, seed=999,
+        image_size=32, radius=radius)
+    # Calibrated: observed ~0.57 trained vs ~0.10 random.
+    assert trained["success_rate"] >= 0.35, trained
+    assert random_r["success_rate"] <= 0.25, random_r
+    assert (trained["success_rate"]
+            >= random_r["success_rate"] + 0.15), (trained, random_r)
+    assert trained["mean_distance"] < random_r["mean_distance"] - 0.2
+
   def test_fixture_smoke(self):
     """The flagship Q-fn trains on random (image, action, target) data."""
     result = T2RModelFixture().random_train(
