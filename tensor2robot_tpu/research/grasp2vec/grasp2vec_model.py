@@ -30,17 +30,21 @@ class _Grasp2VecModule(nn.Module):
   """Scene tower (shared pre/post) + outcome tower → embeddings."""
 
   depth: int = 50
+  width: int = 64
   embedding_size: int = EMBEDDING_SIZE
   remat: bool = False
+  norm: str = "batch"
   compute_dtype: Any = jnp.bfloat16
 
   @nn.compact
   def __call__(self, features, mode: str):
     train = mode == modes.TRAIN
-    scene_tower = ResNet(depth=self.depth, return_spatial=True,
-                         remat=self.remat,
+    scene_tower = ResNet(depth=self.depth, width=self.width,
+                         return_spatial=True,
+                         remat=self.remat, norm=self.norm,
                          dtype=self.compute_dtype, name="scene_tower")
-    outcome_tower = ResNet(depth=self.depth, remat=self.remat,
+    outcome_tower = ResNet(depth=self.depth, width=self.width,
+                           remat=self.remat, norm=self.norm,
                            dtype=self.compute_dtype, name="outcome_tower")
     project = nn.Dense(self.embedding_size, dtype=jnp.float32,
                        name="scene_proj")
@@ -70,18 +74,33 @@ class Grasp2VecModel(AbstractT2RModel):
   """Self-supervised object-embedding model (no labels)."""
 
   def __init__(self, image_size: int = IMAGE_SIZE, depth: int = 50,
-               embedding_size: int = EMBEDDING_SIZE,
-               l2_reg: float = 2e-3, remat: bool = False, **kwargs):
+               width: int = 64, embedding_size: int = EMBEDDING_SIZE,
+               l2_reg: float = 2e-3, remat: bool = False,
+               norm: str = "batch", **kwargs):
     """remat: rematerialize residual blocks on backprop — 3 ResNet-50
     towers at 224×224 are the framework's most activation-hungry
     workload; remat trades ~33% more FLOPs for O(1)-block activation
-    memory, buying larger per-chip batches (see layers.resnet.ResNet)."""
+    memory, buying larger per-chip batches (see layers.resnet.ResNet).
+
+    norm: 'batch' (reference parity) or 'group'. The model's signal
+    φ(pre)−φ(post) is a small difference of large embeddings, so it is
+    exquisitely sensitive to normalization noise. In train mode each
+    BatchNorm call normalizes with its own batch's statistics, so every
+    embedding is coupled to its batchmates and the pre/post common
+    component cancels under the train-time statistics; running averages
+    cannot reproduce that per-batch coupling at eval/serving, and the
+    small difference signal drowns (measured: 0.86 train vs 0.09 eval
+    retrieval accuracy on synthetic triplets). GroupNorm is
+    batch-independent — identical train/eval behavior — and is the
+    recommended setting for training this model from scratch."""
     super().__init__(**kwargs)
     self._image_size = image_size
     self._depth = depth
+    self._width = width
     self._embedding_size = embedding_size
     self._l2_reg = l2_reg
     self._remat = remat
+    self._norm = norm
 
   def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
     del mode
@@ -100,8 +119,10 @@ class Grasp2VecModel(AbstractT2RModel):
   def build_module(self) -> nn.Module:
     return _Grasp2VecModule(
         depth=self._depth,
+        width=self._width,
         embedding_size=self._embedding_size,
         remat=self._remat,
+        norm=self._norm,
         compute_dtype=self.compute_dtype)
 
   def loss_fn(self, outputs, features, labels

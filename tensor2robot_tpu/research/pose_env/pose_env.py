@@ -96,12 +96,19 @@ class PoseEnv:
 
   def _draw_disc(self, image: np.ndarray, center_xy: Tuple[float, float],
                  radius: float, color) -> None:
-    s = self._image_size
-    cx, cy = pose_to_pixel(center_xy, s)
-    r = radius / 2.0 * (s - 1)
-    yy, xx = np.mgrid[0:s, 0:s]
-    mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= r ** 2
-    image[mask] = color
+    draw_disc(image, center_xy, radius, color)
+
+
+def draw_disc(image: np.ndarray, center_xy, radius: float, color) -> None:
+  """Rasterizes a filled disc at table coords [-1, 1]² into a (S, S, 3)
+  uint8 image in place (shared by pose_env and the synthetic research
+  scenes)."""
+  s = image.shape[0]
+  cx, cy = pose_to_pixel(center_xy, s)
+  r = radius / 2.0 * (s - 1)
+  yy, xx = np.mgrid[0:s, 0:s]
+  mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= r ** 2
+  image[mask] = color
 
 
 def pose_to_pixel(pose_xy, image_size: int) -> Tuple[float, float]:

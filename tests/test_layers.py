@@ -94,6 +94,30 @@ class TestResNet:
         variables["batch_stats"], new_state["batch_stats"])
     assert any(jax.tree_util.tree_leaves(changed))
 
+  def test_group_norm_variant(self):
+    """norm='group': no batch_stats collection, identical train/eval
+    outputs (batch-independent normalization)."""
+    module = ResNet(depth=18, width=16, norm="group", dtype=jnp.float32)
+    images = jnp.asarray(
+        np.random.default_rng(0).uniform(size=(2, 32, 32, 3)), jnp.float32)
+    variables = module.init(jax.random.key(0), images)
+    assert "batch_stats" not in variables
+    out_eval = module.apply(variables, images, train=False)
+    out_train = module.apply(variables, images, train=True)
+    np.testing.assert_allclose(np.asarray(out_eval), np.asarray(out_train),
+                               atol=1e-6)
+    # Per-example: a single example's output is independent of the batch
+    # it rides in (the property BatchNorm lacks in train mode).
+    out_single = module.apply(variables, images[:1], train=False)
+    np.testing.assert_allclose(np.asarray(out_single[0]),
+                               np.asarray(out_eval[0]), atol=1e-5)
+
+  def test_bad_norm_kind_raises(self):
+    module = ResNet(depth=18, width=16, norm="layer")
+    images = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    with pytest.raises(ValueError, match="norm"):
+      module.init(jax.random.key(0), images)
+
   def test_remat_matches_dense_forward_and_grads(self):
     """remat=True must be a pure memory/FLOPs trade: same params, same
     outputs, same gradients as the dense tower."""
