@@ -13,13 +13,12 @@ from a conditioning embedding scale/shift the post-BN activations —
 
 from __future__ import annotations
 
-import math
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
 
-from tensor2robot_tpu.layers.vision_layers import normalize_image
+from tensor2robot_tpu.layers.vision_layers import make_norm, normalize_image
 
 # depth -> (block sizes, bottleneck?)
 _CONFIGS = {
@@ -28,37 +27,6 @@ _CONFIGS = {
     50: ((3, 4, 6, 3), True),
     101: ((3, 4, 23, 3), True),
 }
-
-
-def _norm_factory(kind: str, train: bool, dtype: Any):
-  """Returns name -> norm layer for `kind` ∈ {'batch', 'group'}.
-
-  'batch' is the reference's choice. 'group' (GroupNorm, Wu & He 2018)
-  is batch-independent: no running statistics, no train/eval asymmetry,
-  and no per-core-batch stats problem under data parallelism — the
-  right choice for metric-learning heads whose signal is a small
-  difference of large embeddings (grasp2vec), where train-mode BN's
-  within-batch stat coupling doesn't survive into eval mode.
-  """
-  if kind == "batch":
-    return lambda name: nn.BatchNorm(
-        use_running_average=not train, dtype=dtype, name=name)
-  if kind == "group":
-    # gcd(32, C) divides every channel count while defaulting to the
-    # standard 32 groups for the usual 64·2^k widths.
-    return lambda name: _GroupNormAuto(dtype=dtype, name=name)
-  raise ValueError(f"Unknown norm kind {kind!r}; have 'batch', 'group'.")
-
-
-class _GroupNormAuto(nn.Module):
-  """GroupNorm with num_groups = gcd(32, channels)."""
-
-  dtype: Any = jnp.float32
-
-  @nn.compact
-  def __call__(self, x):
-    return nn.GroupNorm(num_groups=math.gcd(32, x.shape[-1]),
-                        dtype=self.dtype)(x)
 
 
 class _Film(nn.Module):
@@ -88,7 +56,7 @@ class _Block(nn.Module):
 
   @nn.compact
   def __call__(self, x, context, train: bool):
-    norm = _norm_factory(self.norm_kind, train, self.dtype)
+    norm = make_norm(self.norm_kind, train, self.dtype)
     out_width = self.width * (4 if self.bottleneck else 1)
     residual = x
     if residual.shape[-1] != out_width or self.stride != 1:
@@ -133,7 +101,7 @@ class ResNet(nn.Module):
   film: bool = False
   return_spatial: bool = False  # also return the pre-pool feature map
   remat: bool = False  # rematerialize each block on the backward pass
-  norm: str = "batch"  # 'batch' (reference) or 'group' (see _norm_factory)
+  norm: str = "batch"  # 'batch' (reference) or 'group' (vision_layers.make_norm)
   dtype: Any = jnp.bfloat16
 
   @nn.compact
@@ -149,7 +117,7 @@ class ResNet(nn.Module):
     x = normalize_image(images, self.dtype)  # uint8 wire → [0,1] on-chip
     x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
                 dtype=self.dtype, name="stem_conv")(x)
-    x = _norm_factory(self.norm, train, self.dtype)("stem_bn")(x)
+    x = make_norm(self.norm, train, self.dtype)("stem_bn")(x)
     x = nn.relu(x)
     x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 

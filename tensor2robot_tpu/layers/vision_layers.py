@@ -8,10 +8,48 @@ convs stride/kernel static so XLA tiles them onto the MXU.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+
+class GroupNormAuto(nn.Module):
+  """GroupNorm with num_groups = gcd(32, channels): divides every
+  channel count while defaulting to the standard 32 groups for the
+  usual 64·2^k widths."""
+
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, x):
+    return nn.GroupNorm(num_groups=math.gcd(32, x.shape[-1]),
+                        dtype=self.dtype)(x)
+
+
+def make_norm(kind: str, train: bool, dtype: Any):
+  """Returns name -> norm layer for `kind` ∈ {'batch', 'group', 'none'}.
+
+  'batch' is the reference's choice. 'group' (GroupNorm, Wu & He 2018)
+  is batch-independent: no running statistics, no train/eval asymmetry,
+  and no per-core-batch stats problem under data parallelism. Required
+  for two situations measured in this repo: difference-of-embeddings
+  metric learning (grasp2vec: train-mode BN's within-batch coupling
+  does not survive into eval) and MAML-wrapped bases (the inner loop
+  never collects running statistics, so eval-mode BN normalizes with
+  init stats — see meta_learning.maml_model). 'none' disables
+  normalization entirely.
+  """
+  if kind == "batch":
+    return lambda name: nn.BatchNorm(
+        use_running_average=not train, dtype=dtype, name=name)
+  if kind == "group":
+    return lambda name: GroupNormAuto(dtype=dtype, name=name)
+  if kind == "none":
+    return lambda name: (lambda x: x)
+  raise ValueError(
+      f"Unknown norm kind {kind!r}; have 'batch', 'group', 'none'.")
 
 
 def normalize_image(image: jnp.ndarray, dtype: Any) -> jnp.ndarray:
@@ -59,7 +97,7 @@ class ImagesToFeatures(nn.Module):
 
   filters: Sequence[int] = (32, 64, 64, 128)
   strides: Sequence[int] = (2, 2, 2, 1)
-  use_batch_norm: bool = True
+  norm: str = "batch"  # 'batch', 'group', or 'none' (see make_norm)
   dtype: Any = jnp.bfloat16
 
   @nn.compact
@@ -69,12 +107,11 @@ class ImagesToFeatures(nn.Module):
           f"filters ({len(self.filters)}) and strides "
           f"({len(self.strides)}) must have equal length.")
     x = normalize_image(images, self.dtype)  # uint8 wire → [0,1] on-chip
+    norm = make_norm(self.norm, train, self.dtype)
     for i, (width, stride) in enumerate(zip(self.filters, self.strides)):
       x = nn.Conv(width, (3, 3), strides=(stride, stride),
                   dtype=self.dtype, name=f"conv{i}")(x)
-      if self.use_batch_norm:
-        x = nn.BatchNorm(use_running_average=not train,
-                         dtype=self.dtype, name=f"bn{i}")(x)
+      x = norm(f"bn{i}")(x)
       x = nn.relu(x)
     return x
 

@@ -19,6 +19,14 @@ Notes:
   - Batch-norm statistics are NOT adapted in the inner loop (running
     state is read-only during adaptation, updates discarded) — matching
     the reference, whose inner loop only substituted weights.
+    CONSEQUENCE (measured): they are never collected during
+    meta-training either, so a BatchNorm base model evaluates/serves
+    with its INIT running statistics — meta-training can look perfect
+    (outer loss ~3e-4 on the two-object meta-reaching task) while
+    eval-mode predictions collapse to the unadapted baseline. Wrap
+    bases built with batch-independent norms instead (e.g.
+    `norm='group'` on the bundled models; layers.vision_layers
+    §make_norm) — the bundled maml factories default to that.
   - PREDICT performs the same adapt-then-forward: meta-serving requires
     condition data in the request, as in the reference's meta predictors.
 """

@@ -35,8 +35,16 @@ def pose_env_maml_model(
 
   float32 compute: MAML inner-loop gradients are unstable in bfloat16
   (same stance as vrgripper_env_models.vrgripper_maml_model).
+
+  norm='group' by default: MAMLModel's inner loop never collects BN
+  running statistics (mutable state is discarded by design), so a
+  BatchNorm base evaluates/serves with INIT statistics — measured on
+  two-object meta-reaching: outer loss 3e-4 in train mode but eval-mode
+  success collapsed to the unadapted baseline. GroupNorm has no
+  batch statistics, making train and eval consistent.
   """
   base_kwargs.setdefault("compute_dtype", jnp.float32)
+  base_kwargs.setdefault("norm", "group")
   base = PoseEnvRegressionModel(**base_kwargs)
   return MAMLModel(
       base,

@@ -32,13 +32,14 @@ class _PoseEnvModule(nn.Module):
   """Conv tower → spatial softmax → pose head."""
 
   pose_dim: int = 2
+  norm: str = "batch"
   compute_dtype: Any = jnp.bfloat16
 
   @nn.compact
   def __call__(self, features, mode: str):
     train = mode == modes.TRAIN
     feature_map = ImagesToFeatures(
-        filters=(32, 48, 64), strides=(2, 2, 1),
+        filters=(32, 48, 64), strides=(2, 2, 1), norm=self.norm,
         dtype=self.compute_dtype, name="tower")(
             features["image"], train=train)
     pose = ImageFeaturesToPose(
@@ -53,11 +54,15 @@ class PoseEnvRegressionModel(RegressionModel):
 
   def __init__(self, image_size: int = IMAGE_SIZE,
                in_image_size: Optional[int] = None, distort: bool = False,
-               **kwargs):
+               norm: str = "batch", **kwargs):
+    """norm: 'batch' (reference parity) or 'group' (batch-independent;
+    required when this model is wrapped by MAMLModel — see
+    layers.vision_layers.make_norm)."""
     super().__init__(label_key="target_pose", **kwargs)
     self._image_size = image_size
     self._in_image_size = in_image_size or image_size
     self._distort = distort
+    self._norm = norm
 
   def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
     del mode
@@ -87,7 +92,8 @@ class PoseEnvRegressionModel(RegressionModel):
     )
 
   def build_module(self) -> nn.Module:
-    return _PoseEnvModule(compute_dtype=self.compute_dtype)
+    return _PoseEnvModule(norm=self._norm,
+                          compute_dtype=self.compute_dtype)
 
   def loss_fn(self, outputs, features, labels
               ) -> Tuple[jnp.ndarray, dict]:

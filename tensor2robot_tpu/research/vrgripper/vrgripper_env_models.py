@@ -33,6 +33,7 @@ class _VRGripperModule(nn.Module):
   action_size: int = ACTION_SIZE
   num_mixture_components: int = 0  # 0 → deterministic regression head
   film: bool = True
+  norm: str = "batch"
   compute_dtype: Any = jnp.bfloat16
 
   @nn.compact
@@ -41,7 +42,7 @@ class _VRGripperModule(nn.Module):
     proprio = features["gripper_pose"].astype(self.compute_dtype)
     context = nn.relu(nn.Dense(32, dtype=self.compute_dtype,
                                name="context_fc")(proprio))
-    tower = ResNet(depth=18, width=32, film=self.film,
+    tower = ResNet(depth=18, width=32, film=self.film, norm=self.norm,
                    dtype=self.compute_dtype, name="tower")
     image_features = tower(features["image"],
                            context=context if self.film else None,
@@ -88,12 +89,15 @@ class VRGripperRegressionModel(RegressionModel):
   def __init__(self, image_size: int = IMAGE_SIZE,
                action_size: int = ACTION_SIZE,
                gripper_pose_size: int = GRIPPER_POSE_SIZE,
-               film: bool = True, **kwargs):
+               film: bool = True, norm: str = "batch", **kwargs):
+    """norm: 'batch' (reference parity) or 'group' (batch-independent;
+    required under MAMLModel — see layers.vision_layers.make_norm)."""
     super().__init__(label_key="action", **kwargs)
     self._image_size = image_size
     self._action_size = action_size
     self._gripper_pose_size = gripper_pose_size
     self._film = film
+    self._norm = norm
 
   def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
     del mode
@@ -110,6 +114,7 @@ class VRGripperRegressionModel(RegressionModel):
         action_size=self._action_size,
         num_mixture_components=0,
         film=self._film,
+        norm=self._norm,
         compute_dtype=self.compute_dtype)
 
 
@@ -127,6 +132,7 @@ class VRGripperEnvModel(VRGripperRegressionModel):
         action_size=self._action_size,
         num_mixture_components=self._num_mixture_components,
         film=self._film,
+        norm=self._norm,
         compute_dtype=self.compute_dtype)
 
   def loss_fn(self, outputs, features, labels
@@ -153,9 +159,13 @@ def vrgripper_maml_model(
 ):
   """Meta-BC variant: MAML over the regression model (reference's
   vrgripper meta/TEC family built on MAMLModel). float32 compute — MAML
-  inner-loop gradients are unstable in bfloat16 (see test_maml)."""
+  inner-loop gradients are unstable in bfloat16 (see test_maml).
+  norm='group' by default: the MAML inner loop never collects BN running
+  statistics, so a BatchNorm base serves with init stats (see
+  pose_env_maml_models / layers.vision_layers.make_norm)."""
   from tensor2robot_tpu.meta_learning import MAMLModel
   base_kwargs.setdefault("compute_dtype", jnp.float32)
+  base_kwargs.setdefault("norm", "group")
   base = VRGripperRegressionModel(**base_kwargs)
   return MAMLModel(
       base,
