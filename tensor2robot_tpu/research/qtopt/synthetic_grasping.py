@@ -25,7 +25,11 @@ Measured on one v5e chip (2026-07-30, 128px, 2.5k steps, 8k logged
 grasps): CEM success 65% / 93% / 100% at radius 0.25 / 0.30 / 0.35 vs
 ~7% / 10% / 13% random — the ~0.2 residual localization error is the
 global-average-pool architecture's (reference parity) position
-bottleneck, not a training/serving defect.
+bottleneck, not a training/serving defect. (Negative results, so the
+next reader doesn't re-try them: replacing the pool with spatial
+softmax doesn't train at all — Q's comparison signal lives in
+activation magnitude — and a mean⊕keypoints hybrid trains to the same
+loss but serves WORSE closed-loop, 18% vs 65% at radius 0.25.)
 """
 
 from __future__ import annotations
