@@ -167,6 +167,8 @@ def train_eval_model(
     handle_preemption: bool = True,
     param_specs=None,
     shard_optimizer_state: bool = False,
+    fsdp: bool = False,
+    fsdp_min_size: int = 4096,
 ) -> TrainEvalResult:
   """Trains (and optionally evaluates/exports) `model`.
 
@@ -196,7 +198,27 @@ def train_eval_model(
     param_specs: tensor-parallel parameter shardings (see
       Trainer/parallel.tp_rules); None = replicated params.
     shard_optimizer_state: ZeRO-1 weight-update sharding (see Trainer).
+    fsdp: derive FSDP/ZeRO-3 parameter shardings from the model
+      automatically (parallel.tp_rules.infer_fsdp_specs_from_model) —
+      the config-file way to turn on fully-sharded training. Mutually
+      exclusive with an explicit param_specs.
+    fsdp_min_size: smallest parameter (elements) worth sharding under
+      fsdp; smaller leaves stay replicated.
   """
+  if fsdp:
+    if param_specs is not None:
+      raise ValueError("Pass either fsdp=True or explicit param_specs, "
+                       "not both.")
+    if shard_optimizer_state:
+      raise ValueError(
+          "fsdp=True already shards optimizer state with the params "
+          "(ZeRO-3 subsumes ZeRO-1); drop shard_optimizer_state.")
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+    from tensor2robot_tpu.parallel import tp_rules
+    if mesh is None:
+      mesh = mesh_lib.create_mesh()
+    param_specs = tp_rules.infer_fsdp_specs_from_model(
+        model, mesh, min_size=fsdp_min_size)
   trainer = Trainer(model, mesh=mesh, seed=seed, param_specs=param_specs,
                     shard_optimizer_state=shard_optimizer_state)
   state = trainer.create_train_state()

@@ -509,3 +509,35 @@ print("TRAIN-EXIT step", int(result.state.step), flush=True)
         log_every_steps=1,
     )
     assert int(result.state.step) == preempted_step + 3
+
+
+class TestFSDPFlag:
+
+  def test_fsdp_flag_trains_and_rejects_param_specs(self, tmp_path):
+    from tensor2robot_tpu.data.default_input_generator import (
+        DefaultRandomInputGenerator)
+    from tensor2robot_tpu.train.train_eval import train_eval_model
+    from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+    model = MockT2RModel(hidden_size=128)
+    result = train_eval_model(
+        model,
+        input_generator_train=DefaultRandomInputGenerator(
+            batch_size=8, seed=0),
+        max_train_steps=2,
+        fsdp=True,
+        fsdp_min_size=128,
+        model_dir=os.fspath(tmp_path))
+    assert int(result.state.step) == 2
+    # The wide kernel really is sharded over the data axis.
+    kernel = result.state.params["Dense_0"]["kernel"]
+    import jax as _jax
+    assert "data" in _jax.tree_util.tree_flatten(
+        tuple(kernel.sharding.spec))[0]
+
+    with pytest.raises(ValueError, match="param_specs"):
+      train_eval_model(
+          MockT2RModel(), fsdp=True, param_specs={},
+          input_generator_train=DefaultRandomInputGenerator(
+              batch_size=8, seed=0),
+          max_train_steps=1)
