@@ -1,0 +1,317 @@
+"""Reproduces the per-family capability checks from README/DESIGN.
+
+One command per model family (or all), each running the REAL pipeline —
+data generation → record parsing → training → export → serving — and
+printing one JSON line with the measured outcome:
+
+    python -m tensor2robot_tpu.bin.run_t2r_trainer  # normal training
+    python -m tensor2robot_tpu.bin.run_capability_checks \
+        --checks pose_env,qtopt,grasp2vec,vrgripper,maml \
+        --scale fast
+
+`--scale full` matches the README numbers (minutes per check on a
+chip); `fast` shrinks images/steps for a quicker signal (still real
+training, looser expectations). Exit code is non-zero if any check
+misses its expectation, so this doubles as an acceptance test on real
+hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+# (fast, full) per-check knobs.
+_SCALES = {
+    "pose_env": {"fast": dict(episodes=1000, steps=800, image=64),
+                 "full": dict(episodes=2000, steps=1500, image=64)},
+    "qtopt": {"fast": dict(grasps=3000, steps=1200, image=64),
+              "full": dict(grasps=8000, steps=2500, image=128)},
+    "grasp2vec": {"fast": dict(triplets=2048, steps=600, image=64),
+                  "full": dict(triplets=8192, steps=1500, image=64)},
+    "vrgripper": {"fast": dict(demos=2000, steps=800, image=64),
+                  "full": dict(demos=4000, steps=1500, image=64)},
+    "maml": {"fast": dict(steps=800, image=64),
+             "full": dict(steps=2000, image=64)},
+}
+# Expectation per (check, scale): the README result with slack for the
+# reduced fast scale.
+_EXPECT = {
+    ("pose_env", "fast"): 0.6, ("pose_env", "full"): 0.95,
+    ("qtopt", "fast"): 0.25, ("qtopt", "full"): 0.5,
+    ("grasp2vec", "fast"): 0.3, ("grasp2vec", "full"): 0.6,
+    ("vrgripper", "fast"): 0.8, ("vrgripper", "full"): 0.95,
+    ("maml", "fast"): 0.7, ("maml", "full"): 0.95,
+}
+
+
+def check_pose_env(scale: str, workdir: str) -> dict:
+  import optax
+
+  from tensor2robot_tpu.data.default_input_generator import (
+      DefaultRecordInputGenerator)
+  from tensor2robot_tpu.export.native_export_generator import (
+      NativeExportGenerator)
+  from tensor2robot_tpu.predictors.exported_model_predictor import (
+      ExportedModelPredictor)
+  from tensor2robot_tpu.research.pose_env import pose_env
+  from tensor2robot_tpu.research.pose_env.eval_policy import evaluate_policy
+  from tensor2robot_tpu.research.pose_env.pose_env_models import (
+      PoseEnvRegressionModel)
+  from tensor2robot_tpu.train.train_eval import train_eval_model
+
+  knobs = _SCALES["pose_env"][scale]
+  rec = os.path.join(workdir, "pose.tfrecord")
+  pose_env.write_tfrecords(rec, num_episodes=knobs["episodes"], seed=0,
+                           image_size=knobs["image"])
+  model = PoseEnvRegressionModel(image_size=knobs["image"],
+                                 optimizer_fn=lambda: optax.adam(1e-3))
+  md = os.path.join(workdir, "pose_run")
+  train_eval_model(
+      model,
+      input_generator_train=DefaultRecordInputGenerator(
+          file_patterns=rec, batch_size=64, seed=1),
+      max_train_steps=knobs["steps"], iterations_per_loop=50,
+      model_dir=md, export_generator=NativeExportGenerator(),
+      log_every_steps=max(100, knobs["steps"]))
+  predictor = ExportedModelPredictor(
+      export_root=os.path.join(md, "export", "latest"))
+  if not predictor.restore(timeout_s=10.0):
+    raise RuntimeError(f"No export appeared under {md}/export/latest")
+  result = evaluate_policy(predictor, num_episodes=200, seed=1234,
+                           image_size=knobs["image"])
+  return {"success_rate": result["success_rate"]}
+
+
+def check_qtopt(scale: str, workdir: str) -> dict:
+  import optax
+
+  from tensor2robot_tpu.data.default_input_generator import (
+      DefaultRecordInputGenerator)
+  from tensor2robot_tpu.export.native_export_generator import (
+      NativeExportGenerator)
+  from tensor2robot_tpu.predictors.exported_model_predictor import (
+      ExportedModelPredictor)
+  from tensor2robot_tpu.research.qtopt import synthetic_grasping as sg
+  from tensor2robot_tpu.research.qtopt.cem import CEMPolicy
+  from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
+  from tensor2robot_tpu.train.train_eval import train_eval_model
+
+  knobs = _SCALES["qtopt"][scale]
+  rec = os.path.join(workdir, "grasps.tfrecord")
+  sg.write_tfrecords(rec, num_examples=knobs["grasps"],
+                     image_size=knobs["image"], seed=0)
+  model = QTOptGraspingModel(image_size=knobs["image"],
+                             in_image_size=knobs["image"],
+                             optimizer_fn=lambda: optax.adam(1e-3))
+  md = os.path.join(workdir, "qtopt_run")
+  train_eval_model(
+      model,
+      input_generator_train=DefaultRecordInputGenerator(
+          file_patterns=rec, batch_size=64, seed=1),
+      max_train_steps=knobs["steps"], iterations_per_loop=50,
+      model_dir=md, export_generator=NativeExportGenerator(),
+      log_every_steps=max(100, knobs["steps"]))
+  predictor = ExportedModelPredictor(
+      export_root=os.path.join(md, "export", "latest"))
+  if not predictor.restore(timeout_s=10.0):
+    raise RuntimeError(f"No export appeared under {md}/export/latest")
+  policy = CEMPolicy(predictor, action_size=4, num_samples=128,
+                     num_elites=10, iterations=4, seed=7)
+  cem = sg.evaluate_grasp_policy(policy, num_scenes=200, seed=5555,
+                                 image_size=knobs["image"])
+  rng = np.random.default_rng(0)
+  rand = sg.evaluate_grasp_policy(
+      lambda im: rng.uniform(-1, 1, 4), num_scenes=200, seed=5555,
+      image_size=knobs["image"])
+  return {"success_rate": cem["success_rate"],
+          "random_success_rate": rand["success_rate"]}
+
+
+def check_grasp2vec(scale: str, workdir: str) -> dict:
+  import optax
+
+  from tensor2robot_tpu.research.grasp2vec import synthetic_scenes as ss
+  from tensor2robot_tpu.research.grasp2vec.grasp2vec_model import (
+      Grasp2VecModel)
+  from tensor2robot_tpu.specs import tensorspec_utils as ts
+  from tensor2robot_tpu.train.trainer import Trainer
+
+  knobs = _SCALES["grasp2vec"][scale]
+  model = Grasp2VecModel(image_size=knobs["image"], depth=18,
+                         norm="group",
+                         optimizer_fn=lambda: optax.adam(1e-3))
+  trainer = Trainer(model, seed=0)
+  batch = 64
+  state = trainer.create_train_state(batch_size=batch)
+  data = ss.sample_triplets(knobs["triplets"], image_size=knobs["image"],
+                            seed=0)
+  rng = np.random.default_rng(1)
+  for _ in range(knobs["steps"]):
+    idx = rng.choice(knobs["triplets"], batch, replace=False)
+    feats = ts.TensorSpecStruct(ss.as_model_batch(data, idx))
+    sharded, _ = trainer.shard_batch((feats, None))
+    state, _ = trainer.train_step(state, sharded, None)
+  heldout = ss.sample_triplets(64, image_size=knobs["image"], seed=777)
+  feats = ts.TensorSpecStruct(ss.as_model_batch(heldout, np.arange(64)))
+  sharded, _ = trainer.shard_batch((feats, None))
+  metrics = trainer.eval_step(state, sharded, None)
+  return {"success_rate": float(metrics["retrieval_accuracy"]),
+          "metric": "held-out 64-way retrieval accuracy"}
+
+
+def check_vrgripper(scale: str, workdir: str) -> dict:
+  import jax
+  import optax
+
+  from tensor2robot_tpu.research.pose_env import pose_env
+  from tensor2robot_tpu.research.pose_env.eval_policy import evaluate_policy
+  from tensor2robot_tpu.research.vrgripper.vrgripper_env_models import (
+      VRGripperRegressionModel)
+  from tensor2robot_tpu.specs import tensorspec_utils as ts
+  from tensor2robot_tpu.train.trainer import Trainer
+
+  knobs = _SCALES["vrgripper"][scale]
+  model = VRGripperRegressionModel(image_size=knobs["image"],
+                                   action_size=2, gripper_pose_size=4,
+                                   optimizer_fn=lambda: optax.adam(1e-3))
+  trainer = Trainer(model, seed=0)
+  batch = 64
+  state = trainer.create_train_state(batch_size=batch)
+  images, targets = pose_env.collect_episodes(
+      knobs["demos"], seed=0, image_size=knobs["image"])
+  rng = np.random.default_rng(1)
+  proprio = rng.normal(0, 1, (knobs["demos"], 4)).astype(np.float32)
+  for _ in range(knobs["steps"]):
+    idx = rng.choice(knobs["demos"], batch, replace=False)
+    feats = ts.TensorSpecStruct({
+        "image": images[idx].astype(np.float32) / 255.0,
+        "gripper_pose": proprio[idx]})
+    labels = ts.TensorSpecStruct({"action": targets[idx]})
+    sharded_f, sharded_l = trainer.shard_batch((feats, labels))
+    state, _ = trainer.train_step(state, sharded_f, sharded_l)
+
+  from tensor2robot_tpu.export import export_utils
+  variables = export_utils.fetch_variables_to_host(
+      state.variables(use_ema=True))
+  predict = jax.jit(model.predict_fn)
+  zero_proprio = np.zeros((1, 4), np.float32)
+
+  def policy(features):
+    feats = ts.TensorSpecStruct({"image": features["image"],
+                                 "gripper_pose": zero_proprio})
+    return predict(variables, feats)
+
+  result = evaluate_policy(policy, num_episodes=200, seed=4321,
+                           image_size=knobs["image"])
+  return {"success_rate": result["success_rate"]}
+
+
+def check_maml(scale: str, workdir: str) -> dict:
+  import jax
+  import jax.numpy as jnp
+  import optax
+
+  from tensor2robot_tpu.research.pose_env import meta_reaching as mr
+  from tensor2robot_tpu.research.pose_env.pose_env_maml_models import (
+      pose_env_maml_model)
+  from tensor2robot_tpu.train.trainer import Trainer
+
+  knobs = _SCALES["maml"][scale]
+  k_c = k_i = 4
+
+  def build(num_inner_steps):
+    return pose_env_maml_model(
+        num_inner_steps=num_inner_steps, inner_lr=0.05,
+        num_condition_samples=k_c, num_inference_samples=k_i,
+        image_size=knobs["image"],
+        optimizer_fn=lambda: optax.adam(1e-3))
+
+  model = build(3)
+  trainer = Trainer(model, seed=0)
+  state = trainer.create_train_state()
+  for step in range(knobs["steps"]):
+    meta, _ = mr.sample_meta_batch(8, k_c, k_i, image_size=knobs["image"],
+                                   seed=100_000 + step)
+    feats = trainer.shard_batch(jax.tree_util.tree_map(jnp.asarray, meta))
+    state, _ = trainer.train_step(state, feats, None)
+  meta, info = mr.sample_meta_batch(32, k_c, k_i,
+                                    image_size=knobs["image"], seed=9999)
+  feats = jax.tree_util.tree_map(jnp.asarray, meta)
+  variables = jax.device_get(state.variables())
+
+  def score(m_eval):
+    out, _ = m_eval.inference_network_fn(variables, feats, "eval")
+    return mr.reach_success(
+        np.asarray(out["inference_output"], np.float32), info)
+
+  adapted = score(model)
+  unadapted = score(build(0))
+  return {"success_rate": adapted["success_rate"],
+          "unadapted_success_rate": unadapted["success_rate"]}
+
+
+_CHECKS = {
+    "pose_env": check_pose_env,
+    "qtopt": check_qtopt,
+    "grasp2vec": check_grasp2vec,
+    "vrgripper": check_vrgripper,
+    "maml": check_maml,
+}
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument("--checks", default="all",
+                      help="comma list of %s or 'all'" % sorted(_CHECKS))
+  parser.add_argument("--scale", choices=("fast", "full"), default="fast")
+  parser.add_argument("--workdir", default=None,
+                      help="scratch dir (default: a TemporaryDirectory)")
+  args = parser.parse_args(argv)
+  names = (sorted(_CHECKS) if args.checks == "all"
+           else [n.strip() for n in args.checks.split(",")])
+  unknown = [n for n in names if n not in _CHECKS]
+  if unknown:
+    parser.error(f"Unknown checks {unknown}; have {sorted(_CHECKS)}")
+
+  failures = 0
+  with tempfile.TemporaryDirectory() as default_dir:
+    workdir_root = args.workdir or default_dir
+    for name in names:
+      start = time.time()
+      # Per-(check, scale) scratch dir, cleared first: train_eval_model
+      # is resume-aware, so reusing a populated run dir would train 0
+      # steps (or crash on shape mismatch across scales).
+      workdir = os.path.join(workdir_root, f"{name}_{args.scale}")
+      if os.path.isdir(workdir):
+        import shutil
+        shutil.rmtree(workdir)
+      os.makedirs(workdir)
+      record = {"check": name, "scale": args.scale}
+      try:
+        result = _CHECKS[name](args.scale, workdir)
+        expect = _EXPECT[(name, args.scale)]
+        passed = bool(result["success_rate"] >= expect)
+        record.update(
+            {k: round(float(v), 4) for k, v in result.items()
+             if isinstance(v, (int, float))})
+        record["expected_at_least"] = expect
+      except Exception as e:  # isolate: one crashing family must not
+        passed = False        # silence the remaining checks' report.
+        record["error"] = f"{type(e).__name__}: {e}"
+      failures += not passed
+      record["passed"] = passed
+      record["seconds"] = round(time.time() - start, 1)
+      print(json.dumps(record), flush=True)
+  return 1 if failures else 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())
