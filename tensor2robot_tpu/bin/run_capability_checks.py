@@ -52,20 +52,38 @@ _EXPECT = {
 }
 
 
-def check_pose_env(scale: str, workdir: str) -> dict:
-  import optax
-
+def _train_and_restore_predictor(model, record_path, steps, run_dir):
+  """Shared record-pipeline half: train -> native export -> predictor."""
   from tensor2robot_tpu.data.default_input_generator import (
       DefaultRecordInputGenerator)
   from tensor2robot_tpu.export.native_export_generator import (
       NativeExportGenerator)
   from tensor2robot_tpu.predictors.exported_model_predictor import (
       ExportedModelPredictor)
+  from tensor2robot_tpu.train.train_eval import train_eval_model
+
+  train_eval_model(
+      model,
+      input_generator_train=DefaultRecordInputGenerator(
+          file_patterns=record_path, batch_size=64, seed=1),
+      max_train_steps=steps, iterations_per_loop=50,
+      model_dir=run_dir, export_generator=NativeExportGenerator(),
+      log_every_steps=max(100, steps))
+  predictor = ExportedModelPredictor(
+      export_root=os.path.join(run_dir, "export", "latest"))
+  if not predictor.restore(timeout_s=10.0):
+    raise RuntimeError(
+        f"No export appeared under {run_dir}/export/latest")
+  return predictor
+
+
+def check_pose_env(scale: str, workdir: str) -> dict:
+  import optax
+
   from tensor2robot_tpu.research.pose_env import pose_env
   from tensor2robot_tpu.research.pose_env.eval_policy import evaluate_policy
   from tensor2robot_tpu.research.pose_env.pose_env_models import (
       PoseEnvRegressionModel)
-  from tensor2robot_tpu.train.train_eval import train_eval_model
 
   knobs = _SCALES["pose_env"][scale]
   rec = os.path.join(workdir, "pose.tfrecord")
@@ -73,18 +91,8 @@ def check_pose_env(scale: str, workdir: str) -> dict:
                            image_size=knobs["image"])
   model = PoseEnvRegressionModel(image_size=knobs["image"],
                                  optimizer_fn=lambda: optax.adam(1e-3))
-  md = os.path.join(workdir, "pose_run")
-  train_eval_model(
-      model,
-      input_generator_train=DefaultRecordInputGenerator(
-          file_patterns=rec, batch_size=64, seed=1),
-      max_train_steps=knobs["steps"], iterations_per_loop=50,
-      model_dir=md, export_generator=NativeExportGenerator(),
-      log_every_steps=max(100, knobs["steps"]))
-  predictor = ExportedModelPredictor(
-      export_root=os.path.join(md, "export", "latest"))
-  if not predictor.restore(timeout_s=10.0):
-    raise RuntimeError(f"No export appeared under {md}/export/latest")
+  predictor = _train_and_restore_predictor(
+      model, rec, knobs["steps"], os.path.join(workdir, "pose_run"))
   result = evaluate_policy(predictor, num_episodes=200, seed=1234,
                            image_size=knobs["image"])
   return {"success_rate": result["success_rate"]}
@@ -93,16 +101,9 @@ def check_pose_env(scale: str, workdir: str) -> dict:
 def check_qtopt(scale: str, workdir: str) -> dict:
   import optax
 
-  from tensor2robot_tpu.data.default_input_generator import (
-      DefaultRecordInputGenerator)
-  from tensor2robot_tpu.export.native_export_generator import (
-      NativeExportGenerator)
-  from tensor2robot_tpu.predictors.exported_model_predictor import (
-      ExportedModelPredictor)
   from tensor2robot_tpu.research.qtopt import synthetic_grasping as sg
   from tensor2robot_tpu.research.qtopt.cem import CEMPolicy
   from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
-  from tensor2robot_tpu.train.train_eval import train_eval_model
 
   knobs = _SCALES["qtopt"][scale]
   rec = os.path.join(workdir, "grasps.tfrecord")
@@ -111,18 +112,8 @@ def check_qtopt(scale: str, workdir: str) -> dict:
   model = QTOptGraspingModel(image_size=knobs["image"],
                              in_image_size=knobs["image"],
                              optimizer_fn=lambda: optax.adam(1e-3))
-  md = os.path.join(workdir, "qtopt_run")
-  train_eval_model(
-      model,
-      input_generator_train=DefaultRecordInputGenerator(
-          file_patterns=rec, batch_size=64, seed=1),
-      max_train_steps=knobs["steps"], iterations_per_loop=50,
-      model_dir=md, export_generator=NativeExportGenerator(),
-      log_every_steps=max(100, knobs["steps"]))
-  predictor = ExportedModelPredictor(
-      export_root=os.path.join(md, "export", "latest"))
-  if not predictor.restore(timeout_s=10.0):
-    raise RuntimeError(f"No export appeared under {md}/export/latest")
+  predictor = _train_and_restore_predictor(
+      model, rec, knobs["steps"], os.path.join(workdir, "qtopt_run"))
   policy = CEMPolicy(predictor, action_size=4, num_samples=128,
                      num_elites=10, iterations=4, seed=7)
   cem = sg.evaluate_grasp_policy(policy, num_scenes=200, seed=5555,
@@ -300,8 +291,9 @@ def main(argv=None) -> int:
         expect = _EXPECT[(name, args.scale)]
         passed = bool(result["success_rate"] >= expect)
         record.update(
-            {k: round(float(v), 4) for k, v in result.items()
-             if isinstance(v, (int, float))})
+            {k: (round(float(v), 4) if isinstance(v, (int, float))
+                 else v)
+             for k, v in result.items()})
         record["expected_at_least"] = expect
       except Exception as e:  # isolate: one crashing family must not
         passed = False        # silence the remaining checks' report.

@@ -541,3 +541,45 @@ class TestFSDPFlag:
           input_generator_train=DefaultRandomInputGenerator(
               batch_size=8, seed=0),
           max_train_steps=1)
+
+
+class TestCapabilityChecksCLI:
+
+  def test_unknown_check_rejected(self, capsys):
+    from tensor2robot_tpu.bin import run_capability_checks as rcc
+    with pytest.raises(SystemExit):
+      rcc.main(["--checks", "nope"])
+
+  def test_error_isolation_and_exit_code(self, monkeypatch, tmp_path,
+                                         capsys):
+    """A crashing family reports passed=false with the error and does
+    not stop later families; exit code reflects any failure."""
+    from tensor2robot_tpu.bin import run_capability_checks as rcc
+
+    calls = []
+
+    def boom(scale, workdir):
+      calls.append("boom")
+      raise RuntimeError("chip on fire")
+
+    def fine(scale, workdir):
+      calls.append("fine")
+      assert os.path.isdir(workdir)
+      return {"success_rate": 1.0}
+
+    monkeypatch.setattr(rcc, "_CHECKS", {"a_boom": boom, "b_fine": fine})
+    monkeypatch.setitem(rcc._EXPECT, ("a_boom", "fast"), 0.5)
+    monkeypatch.setitem(rcc._EXPECT, ("b_fine", "fast"), 0.5)
+    rc = rcc.main(["--checks", "all", "--workdir", os.fspath(tmp_path)])
+    assert rc == 1
+    assert calls == ["boom", "fine"]
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["check"] == "a_boom" and not lines[0]["passed"]
+    assert "chip on fire" in lines[0]["error"]
+    assert lines[1]["check"] == "b_fine" and lines[1]["passed"]
+
+    # All-passing run exits 0.
+    monkeypatch.setattr(rcc, "_CHECKS", {"b_fine": fine})
+    assert rcc.main(["--checks", "all",
+                     "--workdir", os.fspath(tmp_path)]) == 0
