@@ -13,22 +13,19 @@ with the corrected environment (guarded against loops by a marker var).
 import os
 import sys
 
+# Repo root on sys.path so `import tensor2robot_tpu` works without install.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+  sys.path.insert(0, _REPO_ROOT)
+
+from tensor2robot_tpu.utils.cpu_mesh_env import cpu_mesh_env, is_cpu_mesh_env
+
 _MARKER = "_T2R_TPU_TEST_REEXEC"
-
-
-def _needs_reexec() -> bool:
-  if os.environ.get(_MARKER) == "1":
-    return False
-  if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-    return True
-  if "--xla_force_host_platform_device_count" not in os.environ.get(
-      "XLA_FLAGS", ""):
-    return True
-  return False
+_N_DEVICES = 8
 
 
 def pytest_configure(config):
-  if not _needs_reexec():
+  if os.environ.get(_MARKER) == "1" or is_cpu_mesh_env(_N_DEVICES):
     return
   # Restore the real stdout/stderr fds before exec — pytest's fd-level
   # capture has already redirected them, and the exec'd process would
@@ -36,20 +33,7 @@ def pytest_configure(config):
   capman = config.pluginmanager.getplugin("capturemanager")
   if capman is not None:
     capman.stop_global_capturing()
-  env = dict(os.environ)
+  env = cpu_mesh_env(_N_DEVICES)
   env[_MARKER] = "1"
-  env["JAX_PLATFORMS"] = "cpu"
-  env["XLA_FLAGS"] = (
-      env.get("XLA_FLAGS", "")
-      + " --xla_force_host_platform_device_count=8").strip()
-  # Disable the axon TPU plugin registration in sitecustomize.
-  env.pop("PALLAS_AXON_POOL_IPS", None)
-  # Keep XLA's CPU thread usage sane for 8 virtual devices.
-  env.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
   os.execve(sys.executable,
             [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
-
-# Repo root on sys.path so `import tensor2robot_tpu` works without install.
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _REPO_ROOT not in sys.path:
-  sys.path.insert(0, _REPO_ROOT)
