@@ -11,6 +11,7 @@ from __future__ import annotations
 import concurrent.futures
 import itertools
 import queue
+import sys
 import threading
 from typing import Iterator, List, Optional, Sequence
 
@@ -74,6 +75,11 @@ def _pipelined_parse(
   thread = threading.Thread(target=reader, daemon=True, name="t2r-reader")
   thread.start()
 
+  # Bound at definition time: during late interpreter shutdown, module
+  # globals (`sys` included) may already be cleared when the finalizer
+  # below runs, and the guard itself must not throw.
+  is_finalizing = sys.is_finalizing
+
   def iterator() -> Iterator[Batch]:
     try:
       while True:
@@ -85,21 +91,29 @@ def _pipelined_parse(
         yield item.result()  # re-raises parse errors with traceback
     finally:
       stop.set()
-      # Unblock a reader stuck between put attempts and let the pool die.
-      # Exception, not queue.Empty: when an ABANDONED iterator is
-      # finalized at interpreter shutdown, module globals (ours and the
-      # stdlib's) may already be cleared, and even queue.get_nowait's
-      # internal `raise Empty` then fails with TypeError. Both drains are
-      # best-effort; the daemon threads cannot outlive the process.
-      try:
-        while True:
-          futures.get_nowait()
-      except Exception:
-        pass
-      try:
-        pool.shutdown(wait=False, cancel_futures=True)
-      except Exception:
-        pass
+      # When an ABANDONED iterator is finalized at interpreter exit, do
+      # NOT touch the queue or the pool: finalization kills daemon
+      # threads at their next GIL acquisition, so the reader can die
+      # holding the futures-queue mutex or (inside pool.submit) the
+      # executor's _shutdown_lock — and get_nowait()/pool.shutdown()
+      # here would futex-wait on a poisoned lock forever, wedging the
+      # exiting process (observed: main thread stuck in
+      # ThreadPoolExecutor.shutdown under the native parser). The
+      # threads cannot outlive the process; stop.set() is enough.
+      if not is_finalizing():
+        # Unblock a reader stuck between put attempts and let the pool
+        # die promptly on ordinary mid-run abandonment. Both drains are
+        # best-effort (except Exception: a racing reader may refill the
+        # queue between get_nowait calls).
+        try:
+          while True:
+            futures.get_nowait()
+        except Exception:
+          pass
+        try:
+          pool.shutdown(wait=False, cancel_futures=True)
+        except Exception:
+          pass
 
   return iterator()
 

@@ -158,10 +158,8 @@ class TestMultiProcess:
     script = str(tmp_path / "worker.py")
     with open(script, "w") as f:
       f.write(_WORKER_SCRIPT)
-    env = dict(os.environ,
-               JAX_PLATFORMS="cpu",
-               PALLAS_AXON_POOL_IPS="",
-               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    from tensor2robot_tpu.utils.cpu_mesh_env import cpu_mesh_env
+    env = cpu_mesh_env(2)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [

@@ -461,8 +461,8 @@ result = train_eval_model(
 )
 print("TRAIN-EXIT step", int(result.state.step), flush=True)
 """
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
-               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    from tensor2robot_tpu.utils.cpu_mesh_env import cpu_mesh_env
+    env = cpu_mesh_env(2)
     proc = subprocess.Popen([_sys.executable, "-c", script], env=env,
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
