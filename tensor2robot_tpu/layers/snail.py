@@ -84,6 +84,14 @@ class AttentionBlock(nn.Module):
   device's memory shard across the ring (parallel/ring_attention.py);
   the dense core stays the default for the short episodes robot tasks
   actually have (SURVEY.md §5.7).
+
+  `use_flash` switches the in-device core to the Pallas blockwise
+  kernel (ops/flash_attention.py): O(T) HBM traffic instead of the
+  materialized (B, T, T) score tensor. Off by default — at reference
+  episode lengths (T ≲ a few hundred) the dense core is faster to
+  compile and within noise at runtime; flip it on for long in-device
+  sequences. Requires key_size == value_size (one head dim) and is
+  first-order only (custom_vjp) — keep it off under MAML inner loops.
   """
 
   key_size: int
@@ -95,6 +103,7 @@ class AttentionBlock(nn.Module):
   # only its batch shard (unset, the ring path would all-gather the
   # batch and redo identical work per row).
   batch_axis: Any = None
+  use_flash: bool = False
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -105,6 +114,20 @@ class AttentionBlock(nn.Module):
         x.astype(self.dtype))
     values = nn.Dense(self.value_size, dtype=self.dtype, name="value")(
         x.astype(self.dtype))
+    if self.use_flash:
+      if self.seq_mesh is not None:
+        raise ValueError(
+            "use_flash is the in-device core; for sequence-parallel "
+            "attention seq_mesh alone selects ring_attention.")
+      if self.key_size != self.value_size:
+        raise ValueError(
+            "use_flash requires key_size == value_size (one head dim); "
+            f"got {self.key_size} vs {self.value_size}.")
+      from tensor2robot_tpu.ops import flash_attention
+      read = flash_attention(
+          queries[:, :, None, :], keys[:, :, None, :],
+          values[:, :, None, :], causal=True)[:, :, 0, :]
+      return jnp.concatenate([x.astype(self.dtype), read], axis=-1)
     if self.seq_mesh is not None:
       from tensor2robot_tpu.parallel.ring_attention import ring_attention
       read = ring_attention(

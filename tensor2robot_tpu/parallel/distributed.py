@@ -116,7 +116,15 @@ def create_hybrid_mesh(
         f"-1 (fill) is only allowed on dcn axes when dcn_axes is set; "
         f"got ici_axes={dict(ici_axes)}.")
   devices = jax.devices()
-  num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+  # The DCN granule is the TPU slice when the backend reports one;
+  # otherwise (CPU/GPU multi-process) the process is the granule —
+  # cross-process links are the slow tier there, which is exactly the
+  # boundary the dcn axes should straddle. This also lets multi-process
+  # CPU CI exercise the real hybrid layout.
+  process_is_granule = not hasattr(devices[0], "slice_index")
+  granule = (lambda d: d.process_index) if process_is_granule else (
+      lambda d: d.slice_index)
+  num_slices = len({granule(d) for d in devices})
   if not dcn_axes or num_slices == 1:
     return mesh_lib.create_mesh(axes)
 
@@ -134,11 +142,12 @@ def create_hybrid_mesh(
           f"{len(devices)} devices not divisible by {per_slice} "
           f"(ici {ici_axes} × fixed dcn axes).")
     dcn_sizes[fill[0]] = len(devices) // per_slice
-  # DCN axes lead: slice index is the slowest-varying device coordinate.
+  # DCN axes lead: the granule index is the slowest-varying coordinate.
   device_array = mesh_utils.create_hybrid_device_mesh(
       mesh_shape=[1] * len(dcn_sizes) + ici_sizes,
       dcn_mesh_shape=dcn_sizes + [1] * len(ici_sizes),
-      devices=devices)
+      devices=devices,
+      process_is_granule=process_is_granule)
   return Mesh(device_array, tuple(dcn_axes) + tuple(ici_axes))
 
 

@@ -48,10 +48,17 @@ def sample_scenes(
     num_scenes: int,
     image_size: int = 64,
     seed: int = 0,
+    num_distractors: int = 4,
+    occlusion: bool = True,
 ) -> Tuple[np.ndarray, np.ndarray]:
-  """(uint8 images [N, S, S, 3], object positions [N, 2] in [-0.8, 0.8])."""
+  """(uint8 images [N, S, S, 3], object positions [N, 2] in [-0.8, 0.8]).
+
+  Clutter knobs default to the hard scene (capability checks); the
+  miniature CI test disables them to verify machinery on a budget."""
   return pose_env.collect_episodes(num_scenes, seed=seed,
-                                   image_size=image_size)
+                                   image_size=image_size,
+                                   num_distractors=num_distractors,
+                                   occlusion=occlusion)
 
 
 def grasp_success(
@@ -73,6 +80,8 @@ def generate_grasps(
     action_size: int = ACTION_SIZE,
     positive_fraction: float = 0.5,
     radius: float = GRASP_RADIUS,
+    num_distractors: int = 4,
+    occlusion: bool = True,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
   """Logged random-grasp dataset: (images, actions, success labels).
 
@@ -80,7 +89,9 @@ def generate_grasps(
   (std 0.12 gaussian) so the success classes are roughly balanced; the
   rest are uniform in [-1, 1]^A. Labels are the observed outcomes.
   """
-  images, targets = sample_scenes(num_examples, image_size, seed)
+  images, targets = sample_scenes(num_examples, image_size, seed,
+                                  num_distractors=num_distractors,
+                                  occlusion=occlusion)
   rng = np.random.default_rng(seed + 1)
   actions = rng.uniform(-1.0, 1.0,
                         (num_examples, action_size)).astype(np.float32)
@@ -99,6 +110,8 @@ def write_tfrecords(
     action_size: int = ACTION_SIZE,
     positive_fraction: float = 0.5,
     radius: float = GRASP_RADIUS,
+    num_distractors: int = 4,
+    occlusion: bool = True,
 ) -> str:
   """Logged grasps → reference-format tf.Examples (jpeg image, float
   action, float `target_q` success label — QTOptGraspingModel's specs)."""
@@ -108,7 +121,8 @@ def write_tfrecords(
   images, actions, labels = generate_grasps(
       num_examples, image_size=image_size, seed=seed,
       action_size=action_size, positive_fraction=positive_fraction,
-      radius=radius)
+      radius=radius, num_distractors=num_distractors,
+      occlusion=occlusion)
 
   def records():
     for image, action, label in zip(images, actions, labels):
@@ -129,6 +143,8 @@ def evaluate_grasp_policy(
     seed: int = 1000,
     radius: float = GRASP_RADIUS,
     image_transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+    num_distractors: int = 4,
+    occlusion: bool = True,
 ) -> Dict[str, float]:
   """Closed-loop grasp evaluation: scene → policy(image) → success.
 
@@ -143,7 +159,9 @@ def evaluate_grasp_policy(
   """
   if image_transform is None:
     image_transform = lambda im: im.astype(np.float32) / 255.0
-  images, targets = sample_scenes(num_scenes, image_size, seed)
+  images, targets = sample_scenes(num_scenes, image_size, seed,
+                                  num_distractors=num_distractors,
+                                  occlusion=occlusion)
   successes = 0
   distances = []
   for image, target in zip(images, targets):

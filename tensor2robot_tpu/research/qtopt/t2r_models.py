@@ -44,13 +44,24 @@ class _GraspingQModule(nn.Module):
 
   action_size: int = ACTION_SIZE
   compute_dtype: Any = jnp.bfloat16
+  # "batch" is the reference-parity line. "group" (GroupNorm) needs no
+  # cross-batch statistics passes in train mode, removing the extra
+  # activation read/writes that make the BN tower HBM-bandwidth-bound on
+  # TPU (see bench.py's roofline) — the same swap that fixed grasp2vec
+  # training (layers/resnet.py).
+  norm_kind: str = "batch"
 
   @nn.compact
   def __call__(self, features, mode: str):
     train = mode == modes.TRAIN
     dtype = self.compute_dtype
-    norm = lambda name: nn.BatchNorm(
-        use_running_average=not train, dtype=dtype, name=name)
+    if self.norm_kind == "batch":
+      norm = lambda name: nn.BatchNorm(
+          use_running_average=not train, dtype=dtype, name=name)
+    elif self.norm_kind == "group":
+      norm = lambda name: nn.GroupNorm(num_groups=8, dtype=dtype, name=name)
+    else:
+      raise ValueError(f"Unknown norm_kind {self.norm_kind!r}")
 
     x = normalize_image(features["image"], dtype)
     # Stem: 472 -> 118 -> 59.
@@ -101,6 +112,7 @@ class QTOptGraspingModel(CriticModel):
                state_size: int = 0,
                distort: bool = False,
                uint8_images: bool = False,
+               norm: str = "batch",
                **kwargs):
     """state_size > 0 adds a proprioceptive `state` vector feature
     (gripper status etc., reference's non-image state).
@@ -108,7 +120,10 @@ class QTOptGraspingModel(CriticModel):
     uint8_images keeps camera images uint8 all the way to the device
     (the cast + 1/255 rescale runs on-chip, fused into the stem conv):
     4x less host→device and robot→predictor bandwidth for identical
-    math. Changes the serving signature — robots send uint8."""
+    math. Changes the serving signature — robots send uint8.
+
+    norm: "batch" (reference parity) or "group" (TPU-first variant; see
+    _GraspingQModule.norm_kind)."""
     super().__init__(**kwargs)
     self._image_size = image_size
     self._in_image_size = in_image_size or image_size
@@ -116,6 +131,7 @@ class QTOptGraspingModel(CriticModel):
     self._state_size = state_size
     self._distort = distort
     self._image_dtype = np.uint8 if uint8_images else np.float32
+    self._norm = norm
 
   def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
     del mode
@@ -151,4 +167,5 @@ class QTOptGraspingModel(CriticModel):
   def build_module(self) -> nn.Module:
     return _GraspingQModule(
         action_size=self._action_size,
-        compute_dtype=self.compute_dtype)
+        compute_dtype=self.compute_dtype,
+        norm_kind=self._norm)

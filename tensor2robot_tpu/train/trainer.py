@@ -340,6 +340,16 @@ class Trainer:
       self._train_steps = self._build_train_steps()
     return self._train_steps(state, features, labels)
 
+  def aot_train_steps(self, state: TrainState, features, labels=None):
+    """AOT-lowered+compiled `train_steps` executable for the same
+    arguments. Exposes XLA's per-executable introspection
+    (`.cost_analysis()` → flops / bytes accessed), which bench.py uses
+    to emit a measured roofline instead of hand-derived numbers. The
+    executable shares `train_steps`' donation semantics."""
+    if self._train_steps is None:
+      self._train_steps = self._build_train_steps()
+    return self._train_steps.lower(state, features, labels).compile()
+
   def train_step_accum(self, state: TrainState, features, labels=None
                        ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     """One optimizer step over K stacked microbatches (leading axis on

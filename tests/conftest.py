@@ -24,7 +24,49 @@ _MARKER = "_T2R_TPU_TEST_REEXEC"
 _N_DEVICES = 8
 
 
+def pytest_addoption(parser):
+  parser.addoption(
+      "--tpu", action="store_true", default=False,
+      help="Run the on-chip TPU lane: no CPU-mesh re-exec, only "
+           "@pytest.mark.tpu tests (real Pallas kernels + per-family "
+           "on-chip smokes).")
+
+
+# Minutes-long files (research-model training loops): auto-marked
+# `slow` so the inner loop can run `-m "not slow"` (~threefold faster);
+# plain `pytest tests/` still runs everything (the nightly bar).
+_SLOW_FILES = frozenset({
+    "test_research_models.py",
+    "test_research.py",
+    "test_maml.py",
+    "test_train_eval.py",
+})
+
+
+def pytest_collection_modifyitems(config, items):
+  import pytest
+  on_chip = config.getoption("--tpu")
+  for item in items:
+    if os.path.basename(str(item.fspath)) in _SLOW_FILES:
+      item.add_marker(pytest.mark.slow)
+    is_tpu_test = "tpu" in item.keywords
+    if is_tpu_test and not on_chip:
+      item.add_marker(pytest.mark.skip(
+          reason="on-chip test; run with --tpu on a TPU-attached host"))
+    elif on_chip and not is_tpu_test:
+      item.add_marker(pytest.mark.skip(
+          reason="--tpu runs only the on-chip lane"))
+
+
 def pytest_configure(config):
+  config.addinivalue_line(
+      "markers", "tpu: on-chip TPU lane (run via `pytest tests/ --tpu`)")
+  config.addinivalue_line(
+      "markers", "slow: research-model training tests (skip with "
+                 "`-m 'not slow'` for the fast inner loop)")
+  if config.getoption("--tpu"):
+    # On-chip lane: keep the interpreter's real TPU backend.
+    return
   if os.environ.get(_MARKER) == "1" or is_cpu_mesh_env(_N_DEVICES):
     return
   # Restore the real stdout/stderr fds before exec — pytest's fd-level

@@ -135,6 +135,62 @@ result = train_eval_model(
 )
 assert int(result.state.step) == 4, int(result.state.step)
 
+
+# FSDP (ZeRO-3) with params sharded ACROSS PROCESSES: each host owns a
+# quarter of every (divisible) parameter, XLA all-gathers over the
+# cross-process links inside the compiled step.
+from tensor2robot_tpu.parallel import tp_rules
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+from tensor2robot_tpu.train.trainer import Trainer
+
+
+def run_sharded_train_step(mesh, param_specs, tag):
+  model = MockT2RModel()
+  trainer = Trainer(model, mesh=mesh, seed=0, param_specs=param_specs)
+  state = trainer.create_train_state(batch_size=4)
+  rng_np = np.random.default_rng(0)  # same stream on both hosts: the
+  # local quarter of a GLOBAL batch both hosts agree on
+  features = ts.make_random_batch(
+      model.get_feature_specification("train"), 2, rng=rng_np)
+  labels = ts.make_random_batch(
+      model.get_label_specification("train"), 2, rng=rng_np)
+  features, labels = trainer.shard_batch((features, labels))
+  state, metrics = trainer.train_step(state, features, labels)
+  loss = float(metrics["loss"])
+  assert np.isfinite(loss), f"{tag}: non-finite loss {loss}"
+  return trainer, state
+
+
+fsdp_mesh = mesh_lib.create_mesh({"data": -1})
+fsdp_specs = tp_rules.infer_fsdp_specs_from_model(
+    MockT2RModel(), fsdp_mesh, min_size=1)
+trainer, state = run_sharded_train_step(fsdp_mesh, fsdp_specs, "fsdp")
+sharded = [
+    p for p in jax.tree_util.tree_leaves(state.params)
+    if not p.sharding.is_fully_replicated]
+assert sharded, "FSDP produced no cross-process-sharded params"
+assert any(len(p.addressable_shards) < 4 for p in sharded), (
+    "every param fully addressable locally — not sharded across hosts")
+
+# dp×tp on a HYBRID mesh: data axis across processes (the DCN tier on
+# CPU), model axis inside each process (the ICI tier). The mesh layout
+# must keep each model-parallel group within one process.
+hybrid = distributed.create_hybrid_mesh(
+    {"model": jax.local_device_count()}, dcn_axes={"data": -1})
+assert hybrid.axis_names == ("data", "model"), hybrid.axis_names
+assert dict(zip(hybrid.axis_names, hybrid.devices.shape)) == {
+    "data": 2, "model": 2}, hybrid.devices.shape
+for row in hybrid.devices:  # one data-parallel rank = one process
+  assert len({d.process_index for d in row}) == 1, (
+      "model-parallel group spans processes; ICI axis leaked onto DCN")
+tp_specs = tp_rules.infer_dense_tp_specs_from_model(
+    MockT2RModel(), hybrid, min_width=8)
+assert any(
+    "model" in tuple(spec) for spec in jax.tree_util.tree_leaves(
+        tp_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))), (
+    "no param picked up a model-axis TP sharding")
+run_sharded_train_step(hybrid, tp_specs, "dp-tp-hybrid")
+
 distributed.sync_global_devices("test_done")
 print(f"WORKER{process_id}_OK primary={distributed.is_primary()}")
 """

@@ -174,6 +174,35 @@ class TestSnail:
     out = np.asarray(module.apply(variables, perturbed))
     np.testing.assert_allclose(out[0, :4], base[0, :4], atol=1e-5)
 
+  def test_attention_flash_matches_dense(self):
+    """use_flash routes through the Pallas blockwise kernel (interpreted
+    off-TPU) and must match the dense core — values and grads — since
+    both are the same math at different HBM-traffic orders."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.random((2, 128, 4)), jnp.float32)
+    dense = snail.AttentionBlock(key_size=8, value_size=8,
+                                 dtype=jnp.float32)
+    flash = snail.AttentionBlock(key_size=8, value_size=8,
+                                 dtype=jnp.float32, use_flash=True)
+    variables = dense.init(jax.random.key(0), x)
+    out_d = np.asarray(dense.apply(variables, x))
+    out_f = np.asarray(flash.apply(variables, x))
+    np.testing.assert_allclose(out_f, out_d, atol=2e-5)
+    loss = lambda m, p: m.apply({"params": p}, x).sum()
+    g_d = jax.grad(lambda p: loss(dense, p))(variables["params"])
+    g_f = jax.grad(lambda p: loss(flash, p))(variables["params"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4),
+        g_d, g_f)
+
+  def test_attention_flash_requires_matching_sizes(self):
+    module = snail.AttentionBlock(key_size=8, value_size=4,
+                                  dtype=jnp.float32, use_flash=True)
+    x = jnp.zeros((1, 8, 4), jnp.float32)
+    with pytest.raises(ValueError, match="key_size == value_size"):
+      module.init(jax.random.key(0), x)
+
   def test_tc_block_concat_growth(self):
     module = snail.TCBlock(seq_len=8, filters=5, dtype=jnp.float32)
     x = jnp.zeros((2, 8, 3), jnp.float32)

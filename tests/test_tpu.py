@@ -1,0 +1,219 @@
+"""On-chip TPU lane: `python -m pytest tests/ --tpu -q`.
+
+Runs WITHOUT the conftest CPU-mesh re-exec, against the interpreter's
+real TPU backend (the container registers a single-chip backend at
+start). Everything here is skipped in the normal CPU-mesh suite and
+vice versa (tests/conftest.py collection rules).
+
+Covers the two verification gaps VERDICT.md r1 flagged: Pallas kernels
+executing NON-interpreted (numerics vs the XLA reference plus a timing
+sanity bound), and one real train→export→predict smoke per model
+family on the chip.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+def _require_tpu():
+  if jax.default_backend() != "tpu":
+    pytest.skip("no TPU backend attached")
+
+
+def _median_time(fn, n=5):
+  """Median wall time of fn() with a forced host readback."""
+  times = []
+  for _ in range(n):
+    start = time.perf_counter()
+    jax.block_until_ready(fn())
+    times.append(time.perf_counter() - start)
+  return sorted(times)[n // 2]
+
+
+class TestPallasKernelsOnChip:
+  """ops/ kernels compiled for real (interpret=False on the tpu
+  backend) — the CPU suite only ever runs them interpreted."""
+
+  def test_flash_attention_numerics(self):
+    _require_tpu()
+    from tensor2robot_tpu.ops import flash_attention
+    from tensor2robot_tpu.ops.flash_attention import (
+        flash_attention_reference)
+
+    rng = np.random.default_rng(0)
+    b, t, h, d = 2, 256, 4, 64
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+               for _ in range(3))
+    for causal in (False, True):
+      ref = flash_attention_reference(q, k, v, causal=causal)
+      out = flash_attention(q, k, v, causal=causal,
+                            implementation="pallas")
+      # TPU tolerance: both sides run their f32 matmuls as MXU bf16
+      # passes (default precision), in different orders — observed
+      # divergence ~1.6e-3 absolute at O(1) values. A masking or
+      # normalization bug shows up at O(1), far above this bar.
+      np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                 atol=5e-3, rtol=5e-3)
+
+  def test_flash_attention_grads(self):
+    _require_tpu()
+    from tensor2robot_tpu.ops import flash_attention
+    from tensor2robot_tpu.ops.flash_attention import (
+        flash_attention_reference)
+
+    rng = np.random.default_rng(1)
+    b, t, h, d = 1, 256, 2, 64
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+               for _ in range(3))
+    loss_p = lambda q, k, v: flash_attention(
+        q, k, v, causal=True, implementation="pallas").sum()
+    loss_r = lambda q, k, v: flash_attention_reference(
+        q, k, v, causal=True).sum()
+    grads_p = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    grads_r = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for gp, gr in zip(grads_p, grads_r):
+      # Grad path accumulates two MXU-bf16 matmul chains (see fwd test
+      # note); observed on-chip divergence O(1e-3) on O(1) grads.
+      np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                 atol=2e-2, rtol=2e-2)
+
+  def test_flash_attention_timing_sane(self):
+    """The O(T) kernel must not be pathologically slow vs the O(T²)
+    XLA reference at a length where both comfortably fit (T=2048).
+    Loose bound: remote-tunnel dispatch adds noise; this catches
+    orders-of-magnitude regressions (e.g. silent interpret mode), not
+    percent-level ones."""
+    _require_tpu()
+    from tensor2robot_tpu.ops import flash_attention
+    from tensor2robot_tpu.ops.flash_attention import (
+        flash_attention_reference)
+
+    rng = np.random.default_rng(2)
+    b, t, h, d = 2, 2048, 4, 64
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.bfloat16)
+               for _ in range(3))
+    pallas_fn = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, implementation="pallas"))
+    ref_fn = jax.jit(lambda q, k, v: flash_attention_reference(
+        q, k, v, causal=True))
+    jax.block_until_ready(pallas_fn(q, k, v))  # compile
+    jax.block_until_ready(ref_fn(q, k, v))
+    t_pallas = _median_time(lambda: pallas_fn(q, k, v))
+    t_ref = _median_time(lambda: ref_fn(q, k, v))
+    assert t_pallas < 0.25, f"flash fwd took {t_pallas:.3f}s at T={t}"
+    assert t_pallas < 5 * t_ref, (
+        f"flash {t_pallas * 1e3:.1f}ms vs dense {t_ref * 1e3:.1f}ms — "
+        "kernel likely running interpreted or badly tiled")
+
+  def test_spatial_softmax_numerics_and_grad(self):
+    _require_tpu()
+    from tensor2robot_tpu.ops.spatial_softmax import (
+        spatial_softmax, spatial_softmax_reference)
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 32, 32, 16)), jnp.float32)
+    out = spatial_softmax(x)
+    ref = spatial_softmax_reference(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    g = jax.grad(lambda x: spatial_softmax(x).sum())(x)
+    g_ref = jax.grad(lambda x: spatial_softmax_reference(x).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               atol=5e-5, rtol=5e-5)
+
+  def test_snail_attention_flash_path_on_chip(self):
+    """The use_flash wiring (layers/snail.py) through the REAL kernel."""
+    _require_tpu()
+    from tensor2robot_tpu.layers import snail
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.random((2, 128, 8)), jnp.float32)
+    dense = snail.AttentionBlock(key_size=64, value_size=64,
+                                 dtype=jnp.float32)
+    flash = snail.AttentionBlock(key_size=64, value_size=64,
+                                 dtype=jnp.float32, use_flash=True)
+    variables = dense.init(jax.random.key(0), x)
+    np.testing.assert_allclose(
+        np.asarray(flash.apply(variables, x)),
+        np.asarray(dense.apply(variables, x)), atol=5e-3, rtol=5e-3)
+
+
+class TestFamilySmokesOnChip:
+  """Real train steps per model family on the chip — small shapes so
+  each compile stays in the tens of seconds."""
+
+  def _smoke(self, model, batch_size=4):
+    from tensor2robot_tpu.utils.t2r_test_fixture import T2RModelFixture
+    return T2RModelFixture().random_train(
+        model, max_train_steps=2, eval_steps=1, batch_size=batch_size)
+
+  def test_mock_and_export_predict_roundtrip(self, tmp_path):
+    """Mock family + the full export→predict loop on-chip."""
+    _require_tpu()
+    from tensor2robot_tpu import modes
+    from tensor2robot_tpu.data.default_input_generator import (
+        DefaultRandomInputGenerator)
+    from tensor2robot_tpu.export.native_export_generator import (
+        NativeExportGenerator)
+    from tensor2robot_tpu.predictors.exported_model_predictor import (
+        ExportedModelPredictor)
+    from tensor2robot_tpu.train.trainer import Trainer
+    from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+    model = MockT2RModel()
+    trainer = Trainer(model, seed=0)
+    state = trainer.create_train_state()
+    gen = DefaultRandomInputGenerator(batch_size=8, seed=0)
+    gen.set_specification_from_model(model, modes.TRAIN)
+    it = gen.create_dataset_fn(modes.TRAIN)()
+    for _ in range(2):
+      features, labels = trainer.shard_batch(next(it))
+      state, metrics = trainer.train_step(state, features, labels)
+    assert np.isfinite(float(metrics["loss"]))
+
+    root = str(tmp_path / "exports")
+    export_gen = NativeExportGenerator(export_root=root)
+    export_gen.set_specification_from_model(model)
+    export_gen.export(jax.device_get(state.variables(use_ema=True)))
+    predictor = ExportedModelPredictor(root)
+    assert predictor.restore()
+    out = predictor.predict(
+        {"x": np.zeros((4, 3), np.float32)})
+    assert out["inference_output"].shape == (4, 1)
+
+  def test_qtopt_family(self):
+    _require_tpu()
+    from tensor2robot_tpu.research.qtopt.t2r_models import (
+        QTOptGraspingModel)
+    self._smoke(QTOptGraspingModel(image_size=64))
+
+  def test_pose_env_family(self):
+    _require_tpu()
+    from tensor2robot_tpu.research.pose_env.pose_env_models import (
+        PoseEnvRegressionModel)
+    self._smoke(PoseEnvRegressionModel(image_size=64))
+
+  def test_grasp2vec_family(self):
+    _require_tpu()
+    from tensor2robot_tpu.research.grasp2vec.grasp2vec_model import (
+        Grasp2VecModel)
+    self._smoke(Grasp2VecModel(image_size=64, depth=18, width=16),
+                batch_size=4)
+
+  def test_vrgripper_family(self):
+    _require_tpu()
+    from tensor2robot_tpu.research.vrgripper.vrgripper_env_models import (
+        VRGripperRegressionModel)
+    self._smoke(VRGripperRegressionModel(image_size=64))
+
+  def test_maml_family(self):
+    _require_tpu()
+    from tensor2robot_tpu.meta_learning.maml_model import MAMLModel
+    from tensor2robot_tpu.utils.mocks import MockT2RModel
+    self._smoke(MAMLModel(MockT2RModel(), num_inner_steps=1))
