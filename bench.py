@@ -301,8 +301,15 @@ def _bench_input_pipeline(model, batch_size: int,
     out["jpeg_records_per_sec_python"] = round(python_rps, 1)
     out["native_speedup"] = round(native_rps / max(python_rps, 1e-9), 2)
 
-    # Sustained record-fed training (native path), single-step dispatch
-    # with double-buffered device prefetch — the real train_eval feed.
+    # Sustained record-fed training (native path — pinned, not ambient:
+    # an inherited T2R_DISABLE_NATIVE=1 would silently measure the
+    # Python decode path while the JSON attributes it to native),
+    # single-step dispatch with double-buffered device prefetch — the
+    # real train_eval feed.
+    from tensor2robot_tpu.data import native as native_mod
+    prev_disable = os.environ.get("T2R_DISABLE_NATIVE")
+    os.environ["T2R_DISABLE_NATIVE"] = "0"
+    native_mod.reset_cache()
     mesh = mesh_lib.create_mesh()
     trainer = Trainer(model, mesh=mesh, seed=0)
     state = trainer.create_train_state(batch_size=batch_size)
@@ -337,6 +344,11 @@ def _bench_input_pipeline(model, batch_size: int,
     elapsed = time.perf_counter() - start
     batches.close()
     record_fed = n_steps / elapsed
+    if prev_disable is None:
+      os.environ.pop("T2R_DISABLE_NATIVE", None)
+    else:
+      os.environ["T2R_DISABLE_NATIVE"] = prev_disable
+    native_mod.reset_cache()
 
     # The apples-to-apples bar: synthetic-fed at the SAME single-step
     # dispatch (the K=60 headline amortizes dispatch; the record-fed
@@ -393,6 +405,12 @@ def main() -> None:
   value, roofline = _measure_model(
       QTOptGraspingModel(), batch_size, k, WARMUP_LOOPS, MEASURE_LOOPS)
 
+  # space_to_depth stem not benched by default: measured 2026-07-30 at
+  # 159 vs 189 steps/s against the parity stem (same warmup/measure
+  # settings) — the 472² 6D transpose's HBM traffic and the 1.8x stem
+  # FLOPs (192- vs 108-feature kernel) outweigh the MXU lane gain on a
+  # stem that is ~18% of total FLOPs. Kept as a model option + test;
+  # negative result recorded in DESIGN.md §8.
   variants = {}
   for name, kwargs in (
       ("groupnorm_tower", {"norm": "group"}),

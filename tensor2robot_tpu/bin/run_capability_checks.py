@@ -41,14 +41,21 @@ _SCALES = {
     "maml": {"fast": dict(steps=800, image=64),
              "full": dict(steps=2000, image=64)},
 }
-# Expectation per (check, scale): the README result with slack for the
-# reduced fast scale.
+# Expectation per (check, scale), set just-under-measured (10-15%
+# slack) from the committed r2 runs on cluttered scenes
+# (CAPABILITY_r02_full.jsonl / CAPABILITY_r02_fast.jsonl, one v5e,
+# 2026-07-30): pose_env 0.765 fast / 0.925 full (tight 0.05 gate),
+# qtopt 0.47/0.85 (random 0.05), grasp2vec 0.453/0.734 (chance 0.016),
+# vrgripper 0.86/0.95. maml measured 1.0 at both scales — its adapted
+# success saturates by construction (the historical failure mode, the
+# BN-statistics contract, collapses it to the ~0.02 unadapted rate, so
+# a 0.9 bar still catches every real regression ever observed).
 _EXPECT = {
-    ("pose_env", "fast"): 0.6, ("pose_env", "full"): 0.95,
-    ("qtopt", "fast"): 0.25, ("qtopt", "full"): 0.5,
-    ("grasp2vec", "fast"): 0.3, ("grasp2vec", "full"): 0.6,
-    ("vrgripper", "fast"): 0.8, ("vrgripper", "full"): 0.95,
-    ("maml", "fast"): 0.7, ("maml", "full"): 0.95,
+    ("pose_env", "fast"): 0.65, ("pose_env", "full"): 0.80,
+    ("qtopt", "fast"): 0.40, ("qtopt", "full"): 0.72,
+    ("grasp2vec", "fast"): 0.38, ("grasp2vec", "full"): 0.62,
+    ("vrgripper", "fast"): 0.75, ("vrgripper", "full"): 0.85,
+    ("maml", "fast"): 0.90, ("maml", "full"): 0.95,
 }
 
 
@@ -93,9 +100,20 @@ def check_pose_env(scale: str, workdir: str) -> dict:
                                  optimizer_fn=lambda: optax.adam(1e-3))
   predictor = _train_and_restore_predictor(
       model, rec, knobs["steps"], os.path.join(workdir, "pose_run"))
+  # Gate on a TIGHT reach threshold: at the env default (0.10) the
+  # check saturates at 1.0 even with scene clutter (measured r2 full),
+  # so a 2x quality regression would still "pass". 0.05 is inside the
+  # rasterized target disc radius — still a legitimate "reach success",
+  # but sensitive to localization error. The 0.10 figure comes from the
+  # SAME 200 rollouts (extra_thresholds re-buckets the distances).
   result = evaluate_policy(predictor, num_episodes=200, seed=1234,
-                           image_size=knobs["image"])
-  return {"success_rate": result["success_rate"]}
+                           image_size=knobs["image"],
+                           success_threshold=0.05,
+                           extra_thresholds=(0.10,))
+  return {"success_rate": result["success_rate"],
+          "success_rate_at_0p10": result["success_rate_at_0.1"],
+          "mean_reward": result["mean_reward"],
+          "metric": "reach success within 0.05"}
 
 
 def check_qtopt(scale: str, workdir: str) -> dict:

@@ -104,6 +104,10 @@ class AttentionBlock(nn.Module):
   # batch and redo identical work per row).
   batch_axis: Any = None
   use_flash: bool = False
+  # Passed through to ops.flash_attention: "auto" (Pallas on TPU, XLA
+  # reference elsewhere), "pallas" (always the kernel — interpreted
+  # off-TPU; what CPU tests use to actually exercise it), or "xla".
+  flash_implementation: str = "auto"
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -126,7 +130,8 @@ class AttentionBlock(nn.Module):
       from tensor2robot_tpu.ops import flash_attention
       read = flash_attention(
           queries[:, :, None, :], keys[:, :, None, :],
-          values[:, :, None, :], causal=True)[:, :, 0, :]
+          values[:, :, None, :], causal=True,
+          implementation=self.flash_implementation)[:, :, 0, :]
       return jnp.concatenate([x.astype(self.dtype), read], axis=-1)
     if self.seq_mesh is not None:
       from tensor2robot_tpu.parallel.ring_attention import ring_attention

@@ -13,7 +13,7 @@ observation → predict → act loop.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Mapping, Union
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Union
 
 import numpy as np
 
@@ -30,6 +30,7 @@ def evaluate_policy(
     image_size: int = IMAGE_SIZE,
     success_threshold: float = 0.1,
     output_key: str = "inference_output",
+    extra_thresholds: Optional[Sequence[float]] = None,
 ) -> Dict[str, float]:
   """Rolls a policy in PoseEnv; returns success rate + mean reward.
 
@@ -42,9 +43,13 @@ def evaluate_policy(
     image_size: rendered camera size; must match the policy's spec.
     success_threshold: reach distance counted as success (env default).
     output_key: key of the predicted pose in the policy's outputs.
+    extra_thresholds: additional reach thresholds scored from the SAME
+      rollouts (reward = −distance, so re-bucketing is free) — avoids
+      rolling the policy twice to report two thresholds.
 
   Returns:
-    {"success_rate", "mean_reward", "num_episodes"}.
+    {"success_rate", "mean_reward", "num_episodes"} plus one
+    ``success_rate_at_<t>`` per extra threshold.
   """
   predict = policy.predict if hasattr(policy, "predict") else policy
   env = PoseEnv(image_size=image_size, seed=seed,
@@ -63,11 +68,15 @@ def evaluate_policy(
     step = env.step(action)
     successes += bool(step.info["success"])
     rewards.append(step.reward)
-  return {
+  result = {
       "success_rate": successes / num_episodes,
       "mean_reward": float(np.mean(rewards)),
       "num_episodes": float(num_episodes),
   }
+  distances = -np.asarray(rewards)
+  for t in extra_thresholds or ():
+    result[f"success_rate_at_{t}"] = float(np.mean(distances < t))
+  return result
 
 
 def oracle_policy(features: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:

@@ -227,14 +227,19 @@ def collect_episodes(
 
 
 def write_tfrecords(path: str, num_episodes: int, seed: int = 0,
-                    image_size: int = IMAGE_SIZE) -> str:
+                    image_size: int = IMAGE_SIZE,
+                    num_distractors: int = 4,
+                    occlusion: bool = True) -> str:
   """Collects episodes and writes the reference-format TFRecord file:
-  tf.Examples with a jpeg-encoded image and a float target pose."""
+  tf.Examples with a jpeg-encoded image and a float target pose.
+  Clutter knobs pass through to `collect_episodes`."""
   from tensor2robot_tpu.data import example_proto, tfrecord
   from tensor2robot_tpu.utils.image import encode_jpeg
 
   images, poses = collect_episodes(num_episodes, seed=seed,
-                                   image_size=image_size)
+                                   image_size=image_size,
+                                   num_distractors=num_distractors,
+                                   occlusion=occlusion)
 
   def records():
     for image, pose in zip(images, poses):

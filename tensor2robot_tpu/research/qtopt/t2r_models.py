@@ -44,12 +44,25 @@ class _GraspingQModule(nn.Module):
 
   action_size: int = ACTION_SIZE
   compute_dtype: Any = jnp.bfloat16
-  # "batch" is the reference-parity line. "group" (GroupNorm) needs no
-  # cross-batch statistics passes in train mode, removing the extra
-  # activation read/writes that make the BN tower HBM-bandwidth-bound on
-  # TPU (see bench.py's roofline) — the same swap that fixed grasp2vec
-  # training (layers/resnet.py).
+  # "batch" is the reference-parity line. "group" (GroupNorm) removes
+  # BN's cross-batch statistics passes — measured on v5e: NOT faster
+  # (BENCH_r02), which is how we know the tower is MXU-tiling-bound,
+  # not bandwidth-bound.
   norm_kind: str = "batch"
+  # "conv" (parity): Conv 64×(6,6)/4 straight on the 3-channel image —
+  # 3 of the MXU's 128 input lanes do work. "space_to_depth": fold each
+  # 4×4 spatial block into channels first (472²×3 → 119²×48, zero-pad
+  # to 476 so block edges align with the conv's SAME window starts),
+  # then Conv 64×(2,2)/1 VALID → the same 118²×64 map from a 48-wide
+  # MXU-friendly matmul. The (2,2)×48 window covers the parity stem's
+  # (6,6) receptive field (8×8 window, stride 4) — same macro-
+  # architecture, strictly larger stem function class, ~16× better
+  # stem lane occupancy. The classic TPU ResNet-stem trick — which
+  # MEASURES SLOWER here (159 vs 189 steps/s, v5e, 2026-07-30): the
+  # full-resolution transpose's HBM traffic plus 1.8× stem FLOPs
+  # outweigh the lane gain on an 18%-of-FLOPs stem. Kept as an option
+  # and a recorded negative result (DESIGN.md §8).
+  stem_kind: str = "conv"
 
   @nn.compact
   def __call__(self, features, mode: str):
@@ -65,8 +78,24 @@ class _GraspingQModule(nn.Module):
 
     x = normalize_image(features["image"], dtype)
     # Stem: 472 -> 118 -> 59.
-    x = nn.relu(norm("stem_bn")(nn.Conv(
-        64, (6, 6), strides=(4, 4), dtype=dtype, name="stem")(x)))
+    if self.stem_kind == "conv":
+      x = nn.Conv(64, (6, 6), strides=(4, 4), dtype=dtype, name="stem")(x)
+    elif self.stem_kind == "space_to_depth":
+      b = 4
+      size = x.shape[1]
+      # One extra zero block on the bottom/right so the 2×2 block
+      # window yields ceil(size/b) outputs — the parity stem's SAME
+      # spatial dims (472→118, 64→16).
+      pad = (-size) % b + b
+      x = jnp.pad(x, ((0, 0), (0, pad), (0, pad), (0, 0)))
+      n, h, w, c = x.shape
+      x = x.reshape(n, h // b, b, w // b, b, c).transpose(
+          0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b, b * b * c)
+      x = nn.Conv(64, (2, 2), strides=(1, 1), padding="VALID",
+                  dtype=dtype, name="stem_s2d")(x)
+    else:
+      raise ValueError(f"Unknown stem_kind {self.stem_kind!r}")
+    x = nn.relu(norm("stem_bn")(x))
     x = nn.max_pool(x, (2, 2), strides=(2, 2))
     for i in range(3):
       x = nn.relu(norm(f"pre_bn{i}")(nn.Conv(
@@ -113,6 +142,7 @@ class QTOptGraspingModel(CriticModel):
                distort: bool = False,
                uint8_images: bool = False,
                norm: str = "batch",
+               stem: str = "conv",
                **kwargs):
     """state_size > 0 adds a proprioceptive `state` vector feature
     (gripper status etc., reference's non-image state).
@@ -122,8 +152,9 @@ class QTOptGraspingModel(CriticModel):
     4x less host→device and robot→predictor bandwidth for identical
     math. Changes the serving signature — robots send uint8.
 
-    norm: "batch" (reference parity) or "group" (TPU-first variant; see
-    _GraspingQModule.norm_kind)."""
+    norm: "batch" (reference parity) or "group"; stem: "conv" (parity)
+    or "space_to_depth" (MXU-friendly stem lanes) — see
+    _GraspingQModule field docs."""
     super().__init__(**kwargs)
     self._image_size = image_size
     self._in_image_size = in_image_size or image_size
@@ -132,6 +163,7 @@ class QTOptGraspingModel(CriticModel):
     self._distort = distort
     self._image_dtype = np.uint8 if uint8_images else np.float32
     self._norm = norm
+    self._stem = stem
 
   def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
     del mode
@@ -168,4 +200,5 @@ class QTOptGraspingModel(CriticModel):
     return _GraspingQModule(
         action_size=self._action_size,
         compute_dtype=self.compute_dtype,
-        norm_kind=self._norm)
+        norm_kind=self._norm,
+        stem_kind=self._stem)

@@ -175,15 +175,19 @@ class TestSnail:
     np.testing.assert_allclose(out[0, :4], base[0, :4], atol=1e-5)
 
   def test_attention_flash_matches_dense(self):
-    """use_flash routes through the Pallas blockwise kernel (interpreted
-    off-TPU) and must match the dense core — values and grads — since
-    both are the same math at different HBM-traffic orders."""
+    """use_flash routes through the Pallas blockwise kernel and must
+    match the dense core — values and grads — since both are the same
+    math at different HBM-traffic orders. implementation="pallas" is
+    forced: the default "auto" falls back to the XLA reference off-TPU
+    and would make this test vacuous on the CPU suite (the kernel runs
+    interpreted here; non-interpreted coverage is tests/test_tpu.py)."""
     rng = np.random.default_rng(2)
     x = jnp.asarray(rng.random((2, 128, 4)), jnp.float32)
     dense = snail.AttentionBlock(key_size=8, value_size=8,
                                  dtype=jnp.float32)
     flash = snail.AttentionBlock(key_size=8, value_size=8,
-                                 dtype=jnp.float32, use_flash=True)
+                                 dtype=jnp.float32, use_flash=True,
+                                 flash_implementation="pallas")
     variables = dense.init(jax.random.key(0), x)
     out_d = np.asarray(dense.apply(variables, x))
     out_f = np.asarray(flash.apply(variables, x))

@@ -178,6 +178,25 @@ class TestQTOpt:
         QTOptGraspingModel(image_size=64, state_size=3),
         max_train_steps=2)
 
+  def test_space_to_depth_stem_variant(self):
+    """The MXU-friendly stem (BENCH headroom variant): same spatial map
+    as the parity conv stem at both the flagship and small sizes, and
+    the model trains."""
+    from tensor2robot_tpu import modes
+    for size in (64, 472):
+      for stem in ("conv", "space_to_depth"):
+        m = QTOptGraspingModel(image_size=size, stem=stem)
+        module = m.build_module()
+        feats = {
+            "image": jnp.zeros((1, size, size, 3), jnp.float32),
+            "action": jnp.zeros((1, 4), jnp.float32)}
+        out, _ = module.init_with_output(
+            jax.random.key(0), feats, modes.PREDICT)
+        assert out["q_predicted"].shape == (1,), (size, stem)
+    T2RModelFixture().random_train(
+        QTOptGraspingModel(image_size=64, stem="space_to_depth"),
+        max_train_steps=2)
+
   def test_cem_finds_quadratic_optimum(self):
     optimum = jnp.asarray([0.3, -0.6])
 
