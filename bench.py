@@ -420,6 +420,22 @@ def main() -> None:
         QTOptGraspingModel(**kwargs), batch_size, k, 1, 2)
     variants[name] = {"steps_per_sec_per_chip": v, **r}
 
+  # Throughput headroom beyond the parity batch: per-chip batch 128
+  # lifts MFU 10.4% → 16.1% (measured 2026-07-30) — larger spatial
+  # tiles per conv dispatch. The headline stays batch 32 (the fork's
+  # per-GPU batch, the comparable); this line documents the knob.
+  # k=15, not the headline's 60: the K-stacked float32 input at batch
+  # 128 is k × 85 MB — 60 × 342 MB ≈ 20 GB would blow the 16 GB HBM,
+  # so dispatch amortization here differs from the headline (a second
+  # variable in the comparison; the MFU figure is what transfers).
+  v128, r128 = _measure_model(
+      QTOptGraspingModel(), 128, 15, 1, 2)
+  variants["batch128"] = {
+      "steps_per_sec_per_chip": v128,
+      "images_per_sec_per_chip": round(v128 * 128),
+      "mfu": r128.get("mfu"),
+  }
+
   baseline = _derive_baseline(roofline.get("flops_per_step", 0))
   if baseline:
     bar = baseline["a100_fork_estimate_steps_per_sec"]
