@@ -99,6 +99,19 @@ def resolve_export_root(generator, model_dir: Optional[str]) -> None:
     generator.export_root = os.path.join(model_dir, "export", "latest")
 
 
+def fetch_is_collective(variables) -> bool:
+  """True if fetch_variables_to_host(variables) involves a cross-process
+  collective (some leaf is sharded across processes). When False, a
+  non-primary host may skip a fetch whose result it would only discard
+  — when True, every host MUST fetch together or the pod deadlocks."""
+  import jax
+  return any(
+      hasattr(leaf, "is_fully_addressable")
+      and not leaf.is_fully_addressable
+      and not getattr(leaf, "is_fully_replicated", False)
+      for leaf in jax.tree_util.tree_leaves(variables))
+
+
 def fetch_variables_to_host(variables):
   """Device variables → host numpy, safely for ANY sharding.
 
@@ -126,8 +139,19 @@ def fetch_variables_to_host(variables):
 
 
 def export_and_gc(generator, variables, keep: int,
-                  global_step: int = 0) -> str:
-  """One export + version GC (the publish step both export paths share)."""
+                  global_step: int = 0) -> Optional[str]:
+  """One export + version GC (the publish step both export paths share).
+
+  THE chief-worker gate for export artifacts: on multi-host, only the
+  primary writes (N hosts publishing the same versioned directories
+  would race each other and the GC); non-primary processes return
+  None. Callers must still resolve/fetch `variables` on EVERY process
+  before calling — fetch_variables_to_host is a cross-process
+  collective for sharded params, and gating the fetch instead of the
+  write deadlocks the pod."""
+  from tensor2robot_tpu.parallel import distributed
+  if not distributed.is_primary():
+    return None
   export_dir = generator.export(variables, global_step=global_step)
   garbage_collect_exports(generator.export_root, keep=keep)
   return export_dir

@@ -64,13 +64,17 @@ class Exporter:
     device→host transfer until a policy actually publishes."""
     raise NotImplementedError
 
-  def _export(self, variables, global_step: int) -> str:
+  def _export(self, variables, global_step: int) -> Optional[str]:
+    # Resolve the provider on EVERY process (the fetch inside is a
+    # cross-process collective for sharded params); export_and_gc then
+    # writes on the primary only and returns None elsewhere.
     if callable(variables):
       variables = variables()
     export_dir = export_utils.export_and_gc(
         self._generator, variables, keep=self._keep,
         global_step=global_step)
-    _log.info("Exporter %r published %s", self.name, export_dir)
+    if export_dir is not None:
+      _log.info("Exporter %r published %s", self.name, export_dir)
     return export_dir
 
 
@@ -139,7 +143,13 @@ class BestExporter(Exporter):
     if not self._improved(value):
       return None
     export_dir = self._export(variables, global_step)
+    # Policy state advances on every host (eval metrics are replicated,
+    # so the decision stays host-consistent); the state FILE is the
+    # primary's side effect, like the export itself (export_dir is None
+    # on non-primary hosts).
     self._best = value
+    if export_dir is None:
+      return None
     os.makedirs(self.export_root, exist_ok=True)
     # Atomic tmp+rename (same protocol as export publishing): a crash
     # mid-write must never leave a truncated state file behind.

@@ -40,6 +40,10 @@ class AsyncExportHook(Hook):
     self._last_submitted_step: Optional[int] = None
 
   def begin(self, trainer, state, model_dir: str) -> None:
+    # Runs on EVERY host: after_checkpoint's variable snapshot is a
+    # cross-process collective for sharded params, so all hosts must
+    # keep making it together; the artifact writes are chief-gated
+    # inside export_utils.export_and_gc (None on non-primary).
     export_utils.resolve_export_root(self._generator, model_dir)
     self._generator.set_specification_from_model(trainer.model)
     self._worker = threading.Thread(
@@ -59,12 +63,27 @@ class AsyncExportHook(Hook):
           pass
 
   def after_checkpoint(self, step: int, state) -> None:
+    if self._worker is None:  # begin not called
+      return
+    variables = state.variables(use_ema=True)
+    if self._skip_fetch(variables):
+      return
     # Snapshot on the host: the donated device buffers are reused by the
     # next step, so the worker must not touch them.
-    variables = export_utils.fetch_variables_to_host(
-        state.variables(use_ema=True))
+    variables = export_utils.fetch_variables_to_host(variables)
     self._submit((variables, int(state.step)))
     self._last_submitted_step = int(state.step)
+
+  @staticmethod
+  def _skip_fetch(variables) -> bool:
+    """Non-primary hosts snapshot only when the fetch is a collective
+    they must participate in (cross-process-sharded params); with
+    fully-replicated params the primary fetches alone — the others
+    would device_get the whole tree per checkpoint just to have
+    export_and_gc discard it."""
+    from tensor2robot_tpu.parallel import distributed
+    return (not distributed.is_primary()
+            and not export_utils.fetch_is_collective(variables))
 
   def _run(self) -> None:
     while True:
@@ -75,7 +94,8 @@ class AsyncExportHook(Hook):
       try:
         export_dir = export_utils.export_and_gc(
             self._generator, variables, keep=self._keep, global_step=step)
-        _log.info("Async export published %s", export_dir)
+        if export_dir is not None:
+          _log.info("Async export published %s", export_dir)
       except Exception:
         _log.exception("Async export failed; training continues.")
 
@@ -87,16 +107,20 @@ class AsyncExportHook(Hook):
     # deadline (the worker is a daemon thread: abandoning it cannot
     # block interpreter exit).
     if self._worker is None:
+      # begin() starts the worker on EVERY host (the snapshot can be a
+      # cross-process collective — see _skip_fetch); None here means
+      # begin was never called.
       _log.warning("AsyncExportHook.end called without begin; no export "
                    "worker exists, nothing to export.")
       return
     deadline = time.monotonic() + self._shutdown_timeout_s
     submitted = True
     if self._last_submitted_step != int(state.step):
-      variables = export_utils.fetch_variables_to_host(
-          state.variables(use_ema=True))
-      submitted = self._put_with_deadline((variables, int(state.step)),
-                                          deadline)
+      variables = state.variables(use_ema=True)
+      if not self._skip_fetch(variables):
+        variables = export_utils.fetch_variables_to_host(variables)
+        submitted = self._put_with_deadline((variables, int(state.step)),
+                                            deadline)
     if submitted and self._put_with_deadline(self._stop, deadline):
       self._worker.join(timeout=max(0.0, deadline - time.monotonic()))
       if not self._worker.is_alive():

@@ -223,6 +223,17 @@ def train_eval_model(
                     shard_optimizer_state=shard_optimizer_state)
   state = trainer.create_train_state()
 
+  # Side-effect ownership on multi-host (the reference's chief-worker
+  # rule): checkpointing is ALL-process (orbax coordinates per-shard
+  # writes and needs every host to participate); metric/event files and
+  # the operative config are written by the primary only — N hosts
+  # appending to the same files on shared storage interleave/corrupt
+  # them. Export paths run on ALL hosts (their variable fetch is a
+  # cross-process collective for sharded params); the file writes are
+  # chief-gated inside export_utils.export_and_gc.
+  from tensor2robot_tpu.parallel import distributed
+  primary = distributed.is_primary()
+
   checkpoint_manager = None
   metric_writer = None
   if model_dir:
@@ -234,9 +245,10 @@ def train_eval_model(
     if checkpoint_manager.latest_step() is not None:
       state = checkpoint_manager.restore(state)
       _log.info("Resumed from step %d", int(state.step))
-    metric_writer = MetricWriter(model_dir)
-    with open(os.path.join(model_dir, "operative_config.txt"), "w") as f:
-      f.write(operative_config_str())
+    if primary:
+      metric_writer = MetricWriter(model_dir)
+      with open(os.path.join(model_dir, "operative_config.txt"), "w") as f:
+        f.write(operative_config_str())
 
   hooks: List[Hook] = []
   for builder in hook_builders:
@@ -398,12 +410,15 @@ def train_eval_model(
             "delete each other's versions. Give the exporter a different "
             "name or drop one of the two.")
       export_generator.set_specification_from_model(model)
+      # Fetch on every host (collective for sharded params); the write
+      # inside export_and_gc is primary-only (returns None elsewhere).
       export_dir = export_utils.export_and_gc(
           export_generator,
           export_utils.fetch_variables_to_host(
               state.variables(use_ema=True)),
           keep=export_keep, global_step=int(state.step))
-      _log.info("Exported final model to %s", export_dir)
+      if export_dir is not None:
+        _log.info("Exported final model to %s", export_dir)
 
     for hook in hooks:
       hook.end(state)
@@ -506,11 +521,37 @@ def continuous_eval_model(
   template = trainer.create_train_state()
   checkpoint_manager = CheckpointManager(
       os.path.join(model_dir, "checkpoints"))
-  metric_writer = MetricWriter(os.path.join(model_dir, "eval"))
+  # Chief-worker rule (see train_eval_model): metric files belong to
+  # the primary; restore/eval/export-fetch run on all hosts (the export
+  # writes are chief-gated inside export_and_gc).
+  from tensor2robot_tpu.parallel import distributed
+  metric_writer = (MetricWriter(os.path.join(model_dir, "eval"))
+                   if distributed.is_primary() else None)
   exporters = _init_exporters(create_exporters_fn, model, model_dir)
   results: Dict[int, Dict[str, float]] = {}
   stop = False
   last_new_checkpoint = time.monotonic()
+
+  # Multi-host: per-host directory listings and clocks diverge (shared-
+  # storage metadata lag), and _evaluate/export fetches are collectives
+  # — every host must make the SAME evaluate/stop decisions. The
+  # primary decides; the others follow its broadcast. Caps one poll's
+  # batch at _SYNC_CAP steps (the next poll picks up the rest, order
+  # preserved).
+  _SYNC_CAP = 64
+  multi_host = jax.process_count() > 1
+
+  def agree_on_pending(pending, timed_out):
+    if not multi_host:
+      return pending, timed_out
+    from jax.experimental import multihost_utils
+    payload = np.full((_SYNC_CAP + 1,), -1, np.int64)
+    payload[0] = 1 if timed_out else 0
+    steps = pending[:_SYNC_CAP]
+    payload[1:1 + len(steps)] = steps
+    payload = multihost_utils.broadcast_one_to_all(payload)
+    return [int(s) for s in payload[1:] if s >= 0], bool(payload[0])
+
   try:
     while not stop:
       # The trainer process writes the checkpoints; re-read the
@@ -518,17 +559,21 @@ def continuous_eval_model(
       checkpoint_manager.reload()
       pending = sorted(step for step in checkpoint_manager.all_steps()
                        if step not in results)
+      timed_out = (not pending and
+                   time.monotonic() - last_new_checkpoint > timeout_s)
+      pending, timed_out = agree_on_pending(pending, timed_out)
       for step in pending:  # every checkpoint, oldest first — no holes
         last_new_checkpoint = time.monotonic()
         state = checkpoint_manager.restore(template, step=step)
         metrics, images = _evaluate(trainer, model, input_generator_eval,
                                     state, eval_steps, prefetch_depth)
         results[step] = metrics
-        metric_writer.write_scalars(
-            step, {f"eval/{k}": v for k, v in metrics.items()})
-        if images:
-          metric_writer.write_images(
-              step, {f"eval/{k}": v for k, v in images.items()})
+        if metric_writer:
+          metric_writer.write_scalars(
+              step, {f"eval/{k}": v for k, v in metrics.items()})
+          if images:
+            metric_writer.write_images(
+                step, {f"eval/{k}": v for k, v in images.items()})
         _log.info("continuous eval @ step %d: %s", step, metrics)
         _run_exporters_after_eval(exporters, state, metrics)
         if stop_after_step and step >= stop_after_step:
@@ -540,12 +585,13 @@ def continuous_eval_model(
       if stop:
         break
       if not pending:
-        if time.monotonic() - last_new_checkpoint > timeout_s:
+        if timed_out:
           _log.info("continuous eval: no new checkpoint for %.0fs; "
                     "stopping.", timeout_s)
           break
         time.sleep(poll_interval_s)
   finally:
-    metric_writer.close()
+    if metric_writer:
+      metric_writer.close()
     checkpoint_manager.close()
   return results

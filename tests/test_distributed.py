@@ -85,9 +85,11 @@ class TestProfilerHook:
 
 
 _WORKER_SCRIPT = r"""
+import os
 import sys
 process_id = int(sys.argv[1])
 port = sys.argv[2]
+shared_dir = sys.argv[3]
 
 from tensor2robot_tpu.parallel import distributed
 # Must be the first JAX call in the process (before device queries).
@@ -135,6 +137,40 @@ result = train_eval_model(
 )
 assert int(result.state.step) == 4, int(result.state.step)
 
+# Multi-host checkpoint → resume through a SHARED model_dir: orbax
+# coordinates the save across both processes; the second call resumes
+# from step 3 and trains to 6. Side-effect ownership: only the primary
+# may create metric/operative files (chief-worker rule).
+model_dir = os.path.join(shared_dir, "mh_run")
+train_eval_model(
+    MockT2RModel(),
+    input_generator_train=DefaultRandomInputGenerator(batch_size=4, seed=0),
+    max_train_steps=3,
+    model_dir=model_dir,
+    log_every_steps=1,
+)
+resumed = train_eval_model(
+    MockT2RModel(),
+    input_generator_train=DefaultRandomInputGenerator(batch_size=4, seed=0),
+    max_train_steps=6,
+    model_dir=model_dir,
+    log_every_steps=1,
+)
+assert int(resumed.state.step) == 6, int(resumed.state.step)
+distributed.sync_global_devices("mh_ckpt_done")
+primary_files = [p for p in ("metrics.jsonl", "operative_config.txt")
+                 if os.path.exists(os.path.join(model_dir, p))]
+if distributed.is_primary():
+  assert len(primary_files) == 2, primary_files
+else:
+  # Written exactly once (by the primary) — the non-primary never
+  # opened them, and a second writer would have been visible as
+  # interleaved duplicate step records.
+  import json
+  steps = [json.loads(l)["step"] for l in
+           open(os.path.join(model_dir, "metrics.jsonl"))]
+  assert steps == sorted(steps) and len(steps) == len(set(steps)), steps
+
 
 # FSDP (ZeRO-3) with params sharded ACROSS PROCESSES: each host owns a
 # quarter of every (divisible) parameter, XLA all-gathers over the
@@ -171,6 +207,28 @@ sharded = [
 assert sharded, "FSDP produced no cross-process-sharded params"
 assert any(len(p.addressable_shards) < 4 for p in sharded), (
     "every param fully addressable locally — not sharded across hosts")
+
+# Export from CROSS-PROCESS-SHARDED params: the variable fetch is a
+# collective (process_allgather), so EVERY host must run it; the
+# artifact write is chief-gated inside export_and_gc (None here on the
+# non-primary). Gating the fetch instead of the write deadlocks —
+# this is the regression test for exactly that.
+from tensor2robot_tpu.export import export_utils
+from tensor2robot_tpu.export.native_export_generator import (
+    NativeExportGenerator)
+gen = NativeExportGenerator(
+    export_root=os.path.join(shared_dir, "fsdp_export"))
+gen.set_specification_from_model(MockT2RModel())
+export_dir = export_utils.export_and_gc(
+    gen, export_utils.fetch_variables_to_host(state.variables()),
+    keep=2, global_step=int(state.step))
+if distributed.is_primary():
+  assert export_dir is not None and os.path.isdir(export_dir), export_dir
+else:
+  assert export_dir is None, export_dir
+distributed.sync_global_devices("fsdp_export_done")
+assert os.listdir(os.path.join(shared_dir, "fsdp_export")), (
+    "primary published no export version")
 
 # dp×tp on a HYBRID mesh: data axis across processes (the DCN tier on
 # CPU), model axis inside each process (the ICI tier). The mesh layout
@@ -219,9 +277,10 @@ class TestMultiProcess:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
-        subprocess.Popen([_sys.executable, script, str(i), port],
-                         env=env, stdout=subprocess.PIPE,
-                         stderr=subprocess.STDOUT, text=True)
+        subprocess.Popen(
+            [_sys.executable, script, str(i), port, str(tmp_path)],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
         for i in range(2)]
     outputs = []
     try:
