@@ -1,0 +1,73 @@
+"""Direct tests for round-2 infrastructure helpers (cpu_mesh_env,
+fetch_is_collective) that otherwise only have indirect coverage through
+the bootstrap/re-exec and export paths."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.export.export_utils import (
+    fetch_is_collective,
+    fetch_variables_to_host,
+)
+from tensor2robot_tpu.utils.cpu_mesh_env import cpu_mesh_env, is_cpu_mesh_env
+
+
+class TestCpuMeshEnv:
+
+  def test_constructs_bootstrap_env(self):
+    env = cpu_mesh_env(8, base={"XLA_FLAGS": "--foo=1",
+                                "PALLAS_AXON_POOL_IPS": "10.0.0.1"})
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert "--foo=1" in env["XLA_FLAGS"]
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert env["TF_CPP_MIN_LOG_LEVEL"] == "2"
+
+  def test_replaces_stale_count_flag(self):
+    env = cpu_mesh_env(
+        4, base={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    assert env["XLA_FLAGS"].count("xla_force_host_platform_device_count") == 1
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+
+  def test_round_trips_through_is_cpu_mesh_env(self):
+    env = cpu_mesh_env(8, base={})
+    assert is_cpu_mesh_env(8, env)
+    assert is_cpu_mesh_env(4, env)      # more devices than needed: fine
+    assert not is_cpu_mesh_env(16, env)  # fewer than needed: bootstrap
+
+  @pytest.mark.parametrize("env", [
+      {},                                     # nothing set
+      {"JAX_PLATFORMS": "tpu",
+       "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+      {"JAX_PLATFORMS": "cpu"},               # no count flag
+      {"JAX_PLATFORMS": "cpu",
+       "XLA_FLAGS": "--xla_force_host_platform_device_count=bogus"},
+  ])
+  def test_rejects_incomplete_envs(self, env):
+    assert not is_cpu_mesh_env(8, env)
+
+
+class TestFetchIsCollective:
+
+  def test_replicated_and_host_arrays_are_local(self):
+    variables = {"a": jnp.ones((4, 4)), "b": np.ones((2,))}
+    assert not fetch_is_collective(variables)
+    # And the fetch itself stays a plain device_get.
+    fetched = fetch_variables_to_host(variables)
+    np.testing.assert_allclose(fetched["a"], np.ones((4, 4)))
+    np.testing.assert_allclose(fetched["b"], np.ones((2,)))
+
+  def test_sharded_single_process_is_still_local(self):
+    # Sharded across devices but fully addressable (single process):
+    # no cross-process collective needed.
+    from jax.sharding import NamedSharding, PartitionSpec
+    from tensor2robot_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh({"data": -1})
+    arr = jax.device_put(
+        jnp.arange(16.0).reshape(8, 2),
+        NamedSharding(mesh, PartitionSpec("data")))
+    assert not arr.sharding.is_fully_replicated
+    assert arr.is_fully_addressable
+    assert not fetch_is_collective({"w": arr})
