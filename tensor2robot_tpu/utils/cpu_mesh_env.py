@@ -17,6 +17,18 @@ from typing import Mapping, MutableMapping
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
+# sitecustomize (the container's /root/.axon_site) registers the axon TPU
+# plugin whenever this var is set — and the plugin's register() call
+# rewrites jax's platform selection to "axon,cpu" *in-process*, overriding
+# any JAX_PLATFORMS=cpu in the environment. An env with this var set can
+# therefore never be trusted as a CPU mesh, no matter what else it claims.
+_AXON_PLUGIN_VAR = "PALLAS_AXON_POOL_IPS"
+
+# cpu_mesh_env() pops the plugin var; the original value is stashed under
+# this name so tests can reconstruct the *driver's* environment (which
+# keeps the var set) for spoof regression tests.
+_AXON_STASH_VAR = "_T2R_STASHED_PALLAS_AXON_POOL_IPS"
+
 
 def cpu_mesh_env(
     n_devices: int,
@@ -30,16 +42,31 @@ def cpu_mesh_env(
            if not f.startswith(_COUNT_FLAG)]
   flags.append(f"{_COUNT_FLAG}={n_devices}")
   env["XLA_FLAGS"] = " ".join(flags)
-  # Disable the axon TPU plugin registration in sitecustomize.
-  env.pop("PALLAS_AXON_POOL_IPS", None)
+  # Disable the axon TPU plugin registration in sitecustomize (stash the
+  # value so spoof regression tests can reconstruct the driver env).
+  stashed = env.pop(_AXON_PLUGIN_VAR, None)
+  if stashed:
+    env.setdefault(_AXON_STASH_VAR, stashed)
   env.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
   return env
 
 
 def is_cpu_mesh_env(n_devices: int,
                     env: Mapping[str, str] | None = None) -> bool:
-  """True if `env` already forces a CPU backend with >= n_devices."""
+  """True if `env` already forces a CPU backend with >= n_devices.
+
+  This is a *hint*, not proof of what the live backend is: callers about
+  to run multi-device work inline should still confirm against
+  ``len(jax.devices())``. In particular, any env that still carries
+  ``PALLAS_AXON_POOL_IPS`` is rejected outright — sitecustomize registers
+  the single-chip axon TPU plugin at interpreter start and the plugin
+  overrides platform selection in-process, so ``JAX_PLATFORMS=cpu`` plus
+  the device-count flag *lie* in that case (this exact combination is the
+  driver's round-2 multichip environment; see VERDICT round 2, Weak #1).
+  """
   env = os.environ if env is None else env
+  if env.get(_AXON_PLUGIN_VAR):
+    return False
   if env.get("JAX_PLATFORMS", "") != "cpu":
     return False
   for flag in env.get("XLA_FLAGS", "").split():
