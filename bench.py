@@ -1,20 +1,35 @@
-"""Benchmark: flagship train-step throughput + roofline + input pipeline.
+"""Benchmark: flagship train throughput + roofline + input pipeline.
 
-Prints ONE JSON line. Driver contract keys: metric / value / unit /
-vs_baseline. Everything else is the evidence trail:
+Driver contract (VERDICT r2 Weak #2: the contract keys must survive a
+tail-capture that truncates from the FRONT): stdout carries ONE COMPACT
+JSON line (< ~1 KB) with metric / value / unit / vs_baseline plus a few
+scalars; the full evidence trail (roofline, baseline derivation,
+microbenchmarks, variants, input-pipeline study) is written to the
+committed side file named by the "detail" key (BENCH_DETAIL_r03.json).
 
-  - roofline: flops_per_step, hbm_bytes_per_step, achieved_gbps, mfu,
-    mbu — measured via the compiled executable's cost_analysis(), not
-    hand-derived comments.
-  - baseline: the A100 bar DERIVED from the same measured numbers with
-    every assumption stated (see _derive_baseline), replacing round 1's
-    invented 20 steps/sec constant.
-  - variants: the reference-parity BatchNorm line (the headline) plus
-    the TPU-first GroupNorm tower and uint8-wire-format variants that
-    document the headroom beyond parity.
-  - input_pipeline: records/sec and JPEG decodes/sec through
-    DefaultRecordInputGenerator (native on/off) and sustained
-    record-fed training vs synthetic-fed (SURVEY.md §7 hard part 3).
+Headline operating point (stated, per VERDICT r2 #3): QT-Opt grasping
+Q-function, per-chip batch 128, uint8 wire format (model option
+`uint8_images=True` — identical conv math, 4× less batch wire traffic),
+60 scanned steps per dispatch. The metric is per-IMAGE throughput so
+operating points with different batch sizes compare against the same
+derived A100 bar: the bar is a compute roofline × efficiency, which is
+batch-independent per image. The reference-parity batch-32 float32 line
+(comparable with BENCH_r01/r02) is also measured and emitted.
+
+Methodology notes (full numbers in the detail artifact):
+  - Per-call dispatch overhead through this container's remote-tunnel
+    TPU is ~50-100 ms (measured; real TPU hosts: sub-ms). Naive
+    timings INCLUDE it (the honest measured number on this box);
+    steady-state per-step marginals (two scan lengths, differenced)
+    are emitted alongside with the methodology named.
+  - XLA cost_analysis on a scan-of-K executable reports the body once,
+    so flops ARE per-step; bytes-accessed is inflated by stacked-batch
+    slice accounting and is never used for bandwidth claims.
+  - An isolated-conv microbench (same delta method) anchors the MFU
+    ceiling story: the 64-channel tower convs reach 36-90% MFU in
+    isolation, the 3-input-channel parity stem ~3% — the gap between
+    end-to-end MFU and peak is the workload's lane structure, not
+    scheduling loss.
 """
 
 from __future__ import annotations
@@ -27,119 +42,74 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+DETAIL_FILE = "BENCH_DETAIL_r03.json"
+
 WARMUP_LOOPS = 2
 MEASURE_LOOPS = 3
 # Steps fused per dispatch via Trainer.train_steps (lax.scan) — the same
-# in-device loop TPUEstimator ran under TPUConfig(iterations_per_loop),
-# and how train_eval_model(iterations_per_loop=K) trains for real.
-# Throughput plateaus around K=60 on the v5e chip (measured 175 → 200 →
-# 220 steps/s at K=1/20/60); the K-deep stacked batch (~5 GB at batch
-# 32 float32) fits comfortably in 16 GB HBM.
+# in-device loop TPUEstimator ran under TPUConfig(iterations_per_loop).
 ITERATIONS_PER_LOOP = 60
 
-# Chip peaks for mfu/mbu, keyed by substrings of device_kind.
-# v5e ("TPU v5 lite"): 197 TFLOP/s bf16, 819 GB/s HBM (public spec).
+# Chip peaks for mfu, keyed by substrings of device_kind.
+# v5e ("TPU v5 lite"): 197 TFLOP/s bf16 (public spec).
 _CHIP_PEAKS = {
-    "v5 lite": (197e12, 819e9),
-    "v5e": (197e12, 819e9),
-    "v5p": (459e12, 2765e9),
-    "v4": (275e12, 1228e9),
-    "v6": (918e12, 1640e9),
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6": 918e12,
 }
 
 # --- the derived A100 baseline -------------------------------------------
 # BASELINE.json's north star: beat the fork's 8xA100 tf.distribute+NCCL
-# steps/sec/chip by >=3x. That fork number is unmeasurable here (no
-# A100s, no network), so the bar is DERIVED from this run's MEASURED
-# FLOPs/step (XLA cost analysis, cross-checked analytically;
-# dtype/implementation-independent), favorably to the A100:
-#   1. The fork runs fp32 (TF1 default; the reference API surface has
-#      no mixed-precision hooks — SURVEY.md §2): 19.5 TFLOP/s on A100
-#      CUDA cores. If the fork used the NVIDIA TF1 fork's TF32 default
-#      the compute ceiling rises ~8x, but cuDNN TF32 convs at these
-#      shapes (64-channel 3x3) are then firmly bandwidth/launch-bound —
-#      the fp32 figure remains the defensible per-chip anchor; the
-#      raw ceiling is emitted so a reader can substitute assumptions.
-#   2. ideal_bound = A100 fp32 compute roofline for the measured
-#      FLOPs/step: a STRICT upper bound on a fp32 A100 implementation
-#      (100%-of-peak convolutions, zero memory/NCCL/input/dispatch
-#      overhead). An HBM-side bound is NOT derivable here — XLA's
-#      bytes-accessed metric is inflated by stacked-batch slice
-#      accounting (see _cost_analysis) — which only makes ideal_bound
-#      MORE generous to the A100.
-#   3. fork_estimate = ideal_bound x 0.5: cuDNN fp32 convs at these
-#      shapes sustain at most ~50% of peak in isolation (the
-#      fork-favorable end; the per-op TF1 graph executor, BN stats
-#      passes, and NCCL sync push real numbers lower).
-#   4. fork_typical = ideal_bound x 0.25: end-to-end TF1 training
-#      (input pipeline + Python dispatch + NCCL) historically sustains
-#      25-35% of the isolated-conv roofline; 0.25 is the midpoint-low.
-# vs_baseline uses the CONSERVATIVE fork_estimate (so the headline
-# ratio is a lower-bound style claim); vs_a100_ideal_bound and
-# vs_fork_typical are also emitted.
+# throughput per chip by >=3x. That fork number is unmeasurable here (no
+# A100s, no network), so the bar is DERIVED from the measured parity
+# FLOPs/image (XLA cost analysis, cross-checked analytically), favorably
+# to the A100 — full rationale in the detail artifact's
+# baseline.assumptions. The fork would run the PARITY model (float32,
+# batch at its choosing), so the bar is per-image and batch-independent:
+#   a100_img_per_sec(tier) = A100_FP32_FLOPS * tier / flops_per_image
+# vs_baseline uses the CONSERVATIVE fork_estimate tier (0.5 = isolated
+# cuDNN fp32 convs at <=50% of peak with zero other overhead).
 A100_FP32_FLOPS = 19.5e12
 FORK_FP32_CONV_EFFICIENCY = 0.5
 FORK_TYPICAL_E2E_EFFICIENCY = 0.25
+# Analytic parity-model FLOPs (batch 32): used ONLY if cost_analysis
+# fails (ADVICE r2: never emit vs_baseline null — fall back loudly).
+ANALYTIC_PARITY_FLOPS_B32 = 96.4e9
+
+_BASELINE_ASSUMPTIONS = (
+    "fp32 TF1 fork (no mixed-precision hooks in the reference API; "
+    "TF32 would lift the raw ceiling ~8x but those convs are then "
+    "bandwidth/launch-bound at 64-channel shapes); A100 19.5 fp32 "
+    "TFLOP/s; isolated cuDNN fp32 convs <= ~50% of peak "
+    "(fork_estimate tier); end-to-end TF1 training historically 25-35% "
+    "of the isolated-conv roofline (fork_typical tier). The bar is "
+    "per-image: flops_per_image from the measured PARITY model (the "
+    "architecture the fork would run); uint8 wire changes transport, "
+    "not conv math. HBM-side bound intentionally not derived (XLA "
+    "bytes-accessed inflated by stacked-batch slice accounting; "
+    "omitting it only favors the A100).")
 
 
-def _chip_peaks(device_kind: str):
+def _chip_peak(device_kind: str):
   kind = device_kind.lower()
-  for key, peaks in _CHIP_PEAKS.items():
+  for key, peak in _CHIP_PEAKS.items():
     if key in kind:
-      return peaks
-  return None, None
+      return peak
+  return None
 
 
-def _cost_analysis(compiled, k: int):
-  """(flops_per_step, xla_bytes_accessed) from the K-step executable.
-
-  XLA's cost analysis counts a while-loop (lax.scan) body ONCE — trip
-  count is not folded in — and this executable is exactly K identical
-  step bodies plus a negligible epilogue, so the reported flops ARE the
-  per-step figure (verified against an analytic conv-FLOPs count: ~100
-  GF/step for the 472² tower at batch 32 vs 96.4 GF reported; round 1's
-  BENCH divided by K and under-reported 60x).
-
-  "bytes accessed" is returned raw but is NOT usable as an HBM-traffic
-  proxy for this program: slice ops over the K-stacked 5 GB input
-  buffer are billed the full operand size, so the figure (12.3 GB
-  "per step") exceeds what 819 GB/s HBM could move in a 4.8 ms step by
-  3x. It is emitted only as an upper bound with this caveat attached;
-  no mbu/achieved-bandwidth claims are derived from it."""
-  del k  # see docstring: body-once semantics make flops per-step
+def _cost_analysis_flops(compiled):
+  """Per-step flops from the K-step executable (body counted once —
+  see module docstring); 0.0 on failure."""
   try:
     ca = compiled.cost_analysis()
     if isinstance(ca, (list, tuple)):
       ca = ca[0]
-    return (float(ca.get("flops", 0.0)),
-            float(ca.get("bytes accessed", 0.0)))
+    return float(ca.get("flops", 0.0))
   except Exception:
-    return 0.0, 0.0
-
-
-def _derive_baseline(flops_per_step: float):
-  if not flops_per_step:
-    return None
-  ideal = A100_FP32_FLOPS / flops_per_step
-  return {
-      "kind": "derived-a100-fp32-compute-roofline",
-      "a100_ideal_bound_steps_per_sec": round(ideal, 1),
-      "a100_fork_estimate_steps_per_sec": round(
-          ideal * FORK_FP32_CONV_EFFICIENCY, 1),
-      "a100_fork_typical_steps_per_sec": round(
-          ideal * FORK_TYPICAL_E2E_EFFICIENCY, 1),
-      "assumptions": (
-          "fp32 TF1 fork (no mixed-precision hooks in the reference "
-          "API; TF32 would lift the raw ceiling ~8x but those convs "
-          "are then bandwidth/launch-bound at these 64-channel "
-          "shapes); A100 19.5 fp32 TFLOP/s; isolated cuDNN fp32 convs "
-          "<= ~50% of peak (fork_estimate); end-to-end TF1 training "
-          "historically 25-35% of the isolated-conv roofline "
-          "(fork_typical). HBM-side bound intentionally not derived: "
-          "XLA bytes-accessed is inflated by stacked-batch slice "
-          "accounting, and omitting it only favors the A100."),
-      "limit": "compute",
-  }
+    return 0.0
 
 
 def _zeros_batch(model, batch_size, mode):
@@ -157,68 +127,162 @@ def _zeros_batch(model, batch_size, mode):
   return features, labels
 
 
-def _measure_model(model, batch_size: int, k: int, warmup: int,
-                   measure: int):
-  """Steps/sec/chip + roofline for one model via the K-scanned step."""
-  from tensor2robot_tpu import modes
-  from tensor2robot_tpu.parallel import mesh as mesh_lib
-  from tensor2robot_tpu.train.trainer import Trainer
+class _TrainBench:
+  """One compiled K-scanned train-step executable + its measurements."""
 
-  n_chips = jax.device_count()
-  mesh = mesh_lib.create_mesh()
-  trainer = Trainer(model, mesh=mesh, seed=0)
-  state = trainer.create_train_state(batch_size=batch_size)
-  features, labels = _zeros_batch(model, batch_size, modes.TRAIN)
-  features, labels = trainer.shard_batch((features, labels))
+  def __init__(self, model, batch_size: int, k: int):
+    from tensor2robot_tpu import modes
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+    from tensor2robot_tpu.train.trainer import Trainer
 
-  stacked_sharding = mesh_lib.stacked_batch_sharding(mesh, "data")
+    self.batch_size, self.k = batch_size, k
+    mesh = mesh_lib.create_mesh()
+    self._trainer = Trainer(model, mesh=mesh, seed=0)
+    self._state = self._trainer.create_train_state(batch_size=batch_size)
+    features, labels = _zeros_batch(model, batch_size, modes.TRAIN)
+    features, labels = self._trainer.shard_batch((features, labels))
+    sharding = mesh_lib.stacked_batch_sharding(mesh, "data")
 
-  def stack(tree):
-    if tree is None:
-      return None
-    return jax.device_put(
-        jax.tree_util.tree_map(
-            lambda x: jnp.broadcast_to(x[None], (k,) + x.shape), tree),
-        stacked_sharding)
+    def stack(tree):
+      if tree is None:
+        return None
+      return jax.device_put(
+          jax.tree_util.tree_map(
+              lambda x: jnp.broadcast_to(x[None], (k,) + x.shape), tree),
+          sharding)
 
-  stacked_features, stacked_labels = stack(features), stack(labels)
-  compiled = trainer.aot_train_steps(state, stacked_features, stacked_labels)
-  flops_per_step, hbm_bytes_per_step = _cost_analysis(compiled, k)
+    self._batch = (stack(features), stack(labels))
+    self._compiled = self._trainer.aot_train_steps(self._state, *self._batch)
+    self.flops_per_step = _cost_analysis_flops(self._compiled)
 
-  for _ in range(warmup):
-    state, metrics = compiled(state, stacked_features, stacked_labels)
-  float(metrics["loss"])  # host readback: block_until_ready is not a
-  # reliable sync through remote-tunnel backends, an actual value is.
+  def measure(self, warmup: int, measure: int):
+    """Naive steps/sec/chip (includes per-call dispatch overhead)."""
+    n_chips = jax.device_count()
+    state, metrics = self._state, None
+    for _ in range(warmup):
+      state, metrics = self._compiled(state, *self._batch)
+    if metrics is not None:
+      float(metrics["loss"])  # host readback: the only reliable sync
+    start = time.perf_counter()
+    for _ in range(measure):
+      state, metrics = self._compiled(state, *self._batch)
+    float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+    self._state = state
+    return round(measure * self.k / elapsed / n_chips, 3)
 
-  start = time.perf_counter()
-  for _ in range(measure):
-    state, metrics = compiled(state, stacked_features, stacked_labels)
-  float(metrics["loss"])  # forces the whole measured chain
-  elapsed = time.perf_counter() - start
 
-  steps_per_sec = measure * k / elapsed / n_chips
-  sec_per_step = 1.0 / steps_per_sec
-  peak_flops, _ = _chip_peaks(jax.devices()[0].device_kind)
-  roofline = {
-      "flops_per_step": round(flops_per_step),
-      "xla_bytes_accessed_per_step_upper_bound": round(
-          hbm_bytes_per_step),
-      "bytes_caveat": "slice ops over the K-stacked input are billed "
-                      "full operand size; not a real-traffic figure "
-                      "(see bench.py _cost_analysis)",
-  }
-  if flops_per_step:
-    roofline["achieved_tflops"] = round(
-        flops_per_step / sec_per_step / 1e12, 2)
-    if peak_flops:
-      roofline["mfu"] = round(flops_per_step / sec_per_step / peak_flops, 4)
-  return round(steps_per_sec, 3), roofline
+def _measure_config(model, batch_size, k, warmup=WARMUP_LOOPS,
+                    measure=MEASURE_LOOPS):
+  bench = _TrainBench(model, batch_size, k)
+  sps = bench.measure(warmup, measure)
+  return sps, bench.flops_per_step, bench
+
+
+def _steady_state(model, batch_size, k_small, k_big, calls=2,
+                  big_bench=None):
+  """(ms_per_step_marginal, per_call_overhead_ms) via two scan lengths.
+
+  The difference between a k_big call and a k_small call contains no
+  dispatch overhead — it is (k_big - k_small) pure steps. `big_bench`
+  reuses an already-compiled k_big executable (an AOT compile costs
+  tens of seconds on this box)."""
+  per_call = {}
+  for k in (k_small, k_big):
+    if k == k_big and big_bench is not None:
+      bench = big_bench
+    else:
+      bench = _TrainBench(model, batch_size, k)
+    bench.measure(1, 1)  # warm
+    best = None
+    for _ in range(calls):
+      start = time.perf_counter()
+      bench.measure(0, 1)
+      el = time.perf_counter() - start
+      best = el if best is None else min(best, el)
+    per_call[k] = best
+  marginal = (per_call[k_big] - per_call[k_small]) / (k_big - k_small)
+  overhead = per_call[k_small] - k_small * marginal
+  return marginal * 1e3, max(overhead, 0.0) * 1e3
+
+
+def _microbench_convs():
+  """Isolated conv achieved-TFLOP/s at the flagship's shapes (delta
+  method between two scan lengths — immune to dispatch overhead).
+  Anchors the 'where the MFU goes' story (VERDICT r2 #3b)."""
+  from jax import lax
+
+  peak = _chip_peak(jax.devices()[0].device_kind) or 0
+  key = jax.random.key(0)
+
+  def marginal_us(make_fn, x, l1=30, l2=150, calls=2):
+    times = {}
+    for length in (l1, l2):
+      fn = make_fn(length)
+      out = fn(x)
+      jax.block_until_ready(out)
+      best = None
+      for _ in range(calls):
+        start = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        el = time.perf_counter() - start
+        best = el if best is None else min(best, el)
+      times[length] = best
+    return (times[l2] - times[l1]) / (l2 - l1) * 1e6
+
+  def conv_chain(b, hw, c):
+    w = jax.random.normal(key, (3, 3, c, c), jnp.bfloat16) * 0.04
+    x = jax.random.normal(key, (b, hw, hw, c), jnp.bfloat16)
+
+    def make(length):
+      def step(y, _):
+        return lax.conv_general_dilated(
+            y, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")), None
+      return jax.jit(lambda x: lax.scan(step, x, None, length=length)[0])
+    flops = 2 * b * hw * hw * 9 * c * c
+    return make, x, flops
+
+  def stem_chain(b):
+    w = jax.random.normal(key, (6, 6, 3, 64), jnp.bfloat16) * 0.04
+    x = jax.random.normal(key, (b, 472, 472, 3), jnp.bfloat16)
+
+    def make(length):
+      def step(y, _):
+        out = lax.conv_general_dilated(
+            y, w, (4, 4), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y * (1 + 1e-4 * jnp.mean(out).astype(y.dtype)), None
+      return jax.jit(lambda x: lax.scan(step, x, None, length=length)[0])
+    flops = 2 * b * 118 * 118 * 36 * 3 * 64
+    return make, x, flops
+
+  table = {}
+  for name, (make, x, flops) in {
+      "tower_3x3_64ch_59sq_b32": conv_chain(32, 59, 64),
+      "tower_3x3_64ch_59sq_b128": conv_chain(128, 59, 64),
+      "tower_3x3_128ch_59sq_b32": conv_chain(32, 59, 128),
+      "parity_stem_6x6s4_472sq_b32": stem_chain(32),
+  }.items():
+    us = marginal_us(make, x)
+    entry = {"us_per_op": round(us), "achieved_tflops": round(
+        flops / (us * 1e-6) / 1e12, 1)}
+    if peak:
+      entry["mfu"] = round(flops / (us * 1e-6) / peak, 3)
+    table[name] = entry
+  table["note"] = (
+      "delta method (two scan lengths) — per-op marginal cost, no "
+      "dispatch overhead. 64-ch tower convs reach 36%/76% MFU at "
+      "b32/b128 in isolation and ~90% at 128 channels; the "
+      "3-input-channel parity stem ~3%. The end-to-end MFU ceiling is "
+      "the parity architecture's lane structure (Cin=3 stem, Cout=64 "
+      "tower), not scheduling loss.")
+  return table
 
 
 def _make_jpeg_dataset(path: str, num_records: int, image_size: int) -> None:
-  """Writes `num_records` tf.Examples with real JPEG-encoded camera-like
-  images (gradients + random blocks: realistic compressibility), float32
-  actions, and scalar Bellman targets — the flagship's wire format."""
+  """tf.Examples with real JPEG camera-like images (gradients + random
+  blocks: realistic compressibility), float32 actions, scalar targets."""
   from tensor2robot_tpu.data.example_proto import encode_example
   from tensor2robot_tpu.data.tfrecord import TFRecordWriter
   from tensor2robot_tpu.utils.image import encode_jpeg
@@ -229,7 +293,6 @@ def _make_jpeg_dataset(path: str, num_records: int, image_size: int) -> None:
   with TFRecordWriter(path) as writer:
     for i in range(num_records):
       img = np.stack([np.roll(base, 31 * i, axis=1)] * 3, axis=-1).copy()
-      # A few random blocks so JPEG size/decode cost is image-dependent.
       for _ in range(8):
         y, x = rng.integers(0, image_size - 32, size=2)
         img[y:y + 32, x:x + 32] = rng.integers(0, 255, (32, 32, 3))
@@ -240,16 +303,28 @@ def _make_jpeg_dataset(path: str, num_records: int, image_size: int) -> None:
       }))
 
 
-def _bench_input_pipeline(model, batch_size: int,
-                          synthetic_steps_per_sec: float):
-  """records/sec + decodes/sec (native on/off) and record-fed training.
+def _make_raw_uint8_dataset(path: str, num_records: int,
+                            image_size: int) -> None:
+  """tf.Examples with RAW uint8 image bytes (no JPEG): the
+  `wire_format="raw"` + `uint8_images=True` pipeline — zero decode."""
+  from tensor2robot_tpu.data.example_proto import encode_example
+  from tensor2robot_tpu.data.tfrecord import TFRecordWriter
 
-  NOTE this host exposes os.cpu_count() CPU cores (1 in the bench
-  container); JPEG decode throughput scales ~linearly with host cores,
-  so the records/sec here is a per-core figure, not a host ceiling.
-  """
-  import tempfile
+  rng = np.random.default_rng(0)
+  with TFRecordWriter(path) as writer:
+    for _ in range(num_records):
+      img = rng.integers(0, 255, (image_size, image_size, 3), np.uint8)
+      writer.write(encode_example({
+          "image": [img.tobytes()],
+          "action": rng.standard_normal(4).astype(np.float32),
+          "target_q": np.asarray([rng.random()], np.float32),
+      }))
 
+
+def _record_fed_steps_per_sec(model, path, batch_size, n_steps=10):
+  """Sustained record-fed single-step training (the real train_eval
+  feed: reader threads → parse → preprocess → double-buffered device
+  prefetch), measured from a cold pipeline (fill cost included)."""
   from tensor2robot_tpu import modes
   from tensor2robot_tpu.data.default_input_generator import (
       DefaultRecordInputGenerator)
@@ -257,15 +332,60 @@ def _bench_input_pipeline(model, batch_size: int,
   from tensor2robot_tpu.parallel import mesh as mesh_lib
   from tensor2robot_tpu.train.trainer import Trainer
 
-  num_records = 512
+  mesh = mesh_lib.create_mesh()
+  trainer = Trainer(model, mesh=mesh, seed=0)
+  state = trainer.create_train_state(batch_size=batch_size)
+  gen = DefaultRecordInputGenerator(
+      file_patterns=path, batch_size=batch_size, seed=0,
+      num_pipeline_threads=max(1, os.cpu_count() or 1))
+  gen.set_specification_from_model(model, modes.TRAIN)
+
+  def fresh_batches():
+    return prefetch_to_device(
+        gen.create_dataset_fn(modes.TRAIN)(),
+        sharding=trainer.batch_sharding)
+
+  batches = fresh_batches()
+  features, labels = next(batches)
+  state, metrics = trainer.train_step(state, features, labels)  # compile
+  float(metrics["loss"])
+  # Fresh pipeline for the measurement: the tens-of-seconds compile let
+  # every buffer fill; draining them would measure train-step speed,
+  # not sustained throughput. Cold start is the honest side.
+  batches.close()
+  batches = fresh_batches()
+  start = time.perf_counter()
+  for _ in range(n_steps):
+    features, labels = next(batches)
+    state, metrics = trainer.train_step(state, features, labels)
+  float(metrics["loss"])
+  elapsed = time.perf_counter() - start
+  batches.close()
+  return n_steps / elapsed, state, trainer
+
+
+def _bench_input_pipeline(batch_size: int, synthetic_headline_sps: float):
+  """records/sec (native on/off), record-fed training for the JPEG and
+  the raw-uint8 wire (VERDICT r2 #5), H2D bandwidth, and the per-core
+  decode context. This host has os.cpu_count() core(s); JPEG decode and
+  parse scale ~linearly with host cores."""
+  import tempfile
+
+  from tensor2robot_tpu import modes
+  from tensor2robot_tpu.data.default_input_generator import (
+      DefaultRecordInputGenerator)
+  from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
+
+  num_records = 384
+  model = QTOptGraspingModel()
   image_size = model._in_image_size
   out = {"host_cpu_cores": os.cpu_count(), "record_batch_size": batch_size}
 
   with tempfile.TemporaryDirectory() as tmp:
-    path = os.path.join(tmp, "bench.tfrecord")
-    _make_jpeg_dataset(path, num_records, image_size)
+    jpeg_path = os.path.join(tmp, "bench.tfrecord")
+    _make_jpeg_dataset(jpeg_path, num_records, image_size)
     out["jpeg_bytes_per_record"] = round(
-        os.path.getsize(path) / num_records)
+        os.path.getsize(jpeg_path) / num_records)
 
     def records_per_sec(disable_native: bool) -> float:
       from tensor2robot_tpu.data import native
@@ -275,12 +395,12 @@ def _bench_input_pipeline(model, batch_size: int,
       native.reset_cache()
       try:
         gen = DefaultRecordInputGenerator(
-            file_patterns=path, batch_size=batch_size, seed=0,
+            file_patterns=jpeg_path, batch_size=batch_size, seed=0,
             num_pipeline_threads=max(1, os.cpu_count() or 1))
         gen.set_specification_from_model(model, modes.TRAIN)
         it = gen.create_dataset_fn(modes.TRAIN)()
         next(it)  # warm: thread spin-up + first parse
-        n_batches = 12
+        n_batches = 10
         start = time.perf_counter()
         for _ in range(n_batches):
           next(it)
@@ -292,81 +412,67 @@ def _bench_input_pipeline(model, batch_size: int,
           os.environ.pop(env_key, None)
         else:
           os.environ[env_key] = prev
-        native.reset_cache()  # downstream consumers re-decide from env
+        native.reset_cache()
 
     native_rps = records_per_sec(disable_native=False)
     python_rps = records_per_sec(disable_native=True)
-    # One decoded JPEG per record in this schema.
     out["jpeg_records_per_sec_native"] = round(native_rps, 1)
     out["jpeg_records_per_sec_python"] = round(python_rps, 1)
     out["native_speedup"] = round(native_rps / max(python_rps, 1e-9), 2)
+    out["native_note"] = (
+        "native = C++ TFRecord framing + CRC32C + whole-batch parse + "
+        "libjpeg decode; python = pure-Python CRC + per-record parse + "
+        "PIL. Decode-only, the native path measures ~2x PIL "
+        "(1827 vs 879 472^2-decodes/sec, 2026-07-31); the rest of the "
+        "gap is CRC and parse.")
 
-    # Sustained record-fed training (native path — pinned, not ambient:
-    # an inherited T2R_DISABLE_NATIVE=1 would silently measure the
-    # Python decode path while the JSON attributes it to native),
-    # single-step dispatch with double-buffered device prefetch — the
-    # real train_eval feed.
+    # Sustained record-fed training, JPEG/float32 wire (native pinned
+    # on — an inherited T2R_DISABLE_NATIVE=1 would silently measure the
+    # Python path while the JSON attributes it to native).
     from tensor2robot_tpu.data import native as native_mod
     prev_disable = os.environ.get("T2R_DISABLE_NATIVE")
     os.environ["T2R_DISABLE_NATIVE"] = "0"
     native_mod.reset_cache()
-    mesh = mesh_lib.create_mesh()
-    trainer = Trainer(model, mesh=mesh, seed=0)
-    state = trainer.create_train_state(batch_size=batch_size)
-    gen = DefaultRecordInputGenerator(
-        file_patterns=path, batch_size=batch_size, seed=0,
-        num_pipeline_threads=max(1, os.cpu_count() or 1))
-    gen.set_specification_from_model(model, modes.TRAIN)
+    record_fed, state, trainer = _record_fed_steps_per_sec(
+        model, jpeg_path, batch_size)
+    out["record_fed_jpeg_steps_per_sec"] = round(record_fed, 2)
 
-    def fresh_batches():
-      return prefetch_to_device(
-          gen.create_dataset_fn(modes.TRAIN)(),
-          sharding=trainer.batch_sharding)
+    # Raw-uint8 wire (VERDICT r2 #5): no JPEG decode, 4x less H2D than
+    # float32 — the two mitigations visible despite this container's
+    # 1-core host and tunnel H2D.
+    raw_path = os.path.join(tmp, "bench_raw.tfrecord")
+    _make_raw_uint8_dataset(raw_path, num_records, image_size)
+    raw_model = QTOptGraspingModel(uint8_images=True, wire_format="raw")
+    record_fed_raw, _, _ = _record_fed_steps_per_sec(
+        raw_model, raw_path, batch_size)
+    out["record_fed_uint8_steps_per_sec"] = round(record_fed_raw, 2)
+    out["uint8_vs_jpeg_record_fed"] = round(
+        record_fed_raw / max(record_fed, 1e-9), 2)
 
-    batches = fresh_batches()
-    features, labels = next(batches)
-    state, metrics = trainer.train_step(state, features, labels)  # compile
-    float(metrics["loss"])
-    # Fresh pipeline for the measurement: during the tens-of-seconds
-    # compile above, the reader/parse threads filled every buffer
-    # (prefetch_batches + device prefetch depth ≈ 6 ready batches), and
-    # draining those would measure train-step speed, not sustained
-    # record-fed throughput. Starting cold includes the fill cost —
-    # the honest (slightly pessimistic) side.
-    batches.close()
-    batches = fresh_batches()
-    n_steps = 10
-    start = time.perf_counter()
-    for _ in range(n_steps):
-      features, labels = next(batches)
-      state, metrics = trainer.train_step(state, features, labels)
-    float(metrics["loss"])
-    elapsed = time.perf_counter() - start
-    batches.close()
-    record_fed = n_steps / elapsed
-    if prev_disable is None:
-      os.environ.pop("T2R_DISABLE_NATIVE", None)
-    else:
-      os.environ["T2R_DISABLE_NATIVE"] = prev_disable
-    native_mod.reset_cache()
-
-    # The apples-to-apples bar: synthetic-fed at the SAME single-step
-    # dispatch (the K=60 headline amortizes dispatch; the record-fed
-    # loop cannot, so compare like with like, and report both).
+    # Synthetic-fed at the SAME single-step dispatch (the K-scanned
+    # headline amortizes dispatch; the record-fed loop cannot).
     sfeat, slab = _zeros_batch(model, batch_size, modes.TRAIN)
     sfeat, slab = trainer.shard_batch((sfeat, slab))
     state, metrics = trainer.train_step(state, sfeat, slab)
     float(metrics["loss"])
+    n_steps = 10
     start = time.perf_counter()
     for _ in range(n_steps):
       state, metrics = trainer.train_step(state, sfeat, slab)
     float(metrics["loss"])
     elapsed = time.perf_counter() - start
     synthetic_k1 = n_steps / elapsed
+    out["synthetic_steps_per_sec_k1"] = round(synthetic_k1, 2)
+    out["record_fed_uint8_fraction_of_k1"] = round(
+        record_fed_raw / synthetic_k1, 3)
 
-    # Attribute the record-fed gap: host→device bandwidth of one
-    # feature batch (on this box the chip hangs off a remote tunnel,
-    # orders of magnitude below a real TPU host's PCIe/DMA path).
+    if prev_disable is None:
+      os.environ.pop("T2R_DISABLE_NATIVE", None)
+    else:
+      os.environ["T2R_DISABLE_NATIVE"] = prev_disable
+    native_mod.reset_cache()
+
+    # H2D bandwidth of one float32 feature batch (remote-tunnel path).
     one_batch = np.zeros((batch_size, image_size, image_size, 3),
                          np.float32)
     jax.block_until_ready(jax.device_put(one_batch))  # warm path
@@ -374,95 +480,157 @@ def _bench_input_pipeline(model, batch_size: int,
     jax.block_until_ready(jax.device_put(one_batch))
     h2d = one_batch.nbytes / (time.perf_counter() - start)
     out["h2d_gbps"] = round(h2d / 1e9, 3)
-
-    out["record_fed_steps_per_sec"] = round(record_fed, 2)
-    out["synthetic_steps_per_sec_k1"] = round(synthetic_k1, 2)
-    out["record_fed_fraction_of_k1"] = round(record_fed / synthetic_k1, 3)
-    out["record_fed_fraction_of_headline"] = round(
-        record_fed / synthetic_steps_per_sec, 3)
     out["note"] = (
-        "record-fed throughput on this box is bounded by two "
-        "container artifacts, not the pipeline design: a 1-core host "
-        "(JPEG decode scales ~linearly with cores; feeding "
-        f"~{round(synthetic_steps_per_sec * batch_size)} images/sec "
-        f"needs ~{round(synthetic_steps_per_sec * batch_size / max(native_rps, 1))} "
-        "cores at the measured per-core rate — TPU hosts have ~100+) "
-        f"and a remote-tunnel H2D path measured at {h2d / 1e9:.2f} GB/s "
-        "(real hosts: tens of GB/s; the float32 wire batch alone is "
-        f"{one_batch.nbytes / 1e6:.0f} MB/step — uint8_images=True "
-        "cuts it 4x and removes the decode entirely)")
+        "record-fed throughput on this box is bounded by container "
+        "artifacts, not pipeline design: a 1-core host (decode+parse "
+        "scale ~linearly with cores; feeding "
+        f"~{round(synthetic_headline_sps)} img/sec needs "
+        f"~{round(synthetic_headline_sps / max(native_rps, 1))} cores "
+        "at the measured per-core JPEG rate — real TPU hosts have "
+        f"~100+) and a {h2d / 1e9:.2f} GB/s tunnel H2D (real hosts: "
+        "tens of GB/s). The raw-uint8 wire removes decode entirely and "
+        "cuts wire bytes 4x vs float32 — its measured multiple over "
+        "the JPEG/float path above is the design margin this box can "
+        "demonstrate.")
   return out
 
 
 def main() -> None:
   from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
 
-  batch_size = QTOptGraspingModel.benchmark_batch_size
+  parity_batch = QTOptGraspingModel.benchmark_batch_size  # 32
   k = ITERATIONS_PER_LOOP
+  device_kind = jax.devices()[0].device_kind
+  peak = _chip_peak(device_kind)
 
-  # Headline: the reference-parity workload (BatchNorm tower, float32
-  # wire format) — comparable with BENCH_r01.
-  value, roofline = _measure_model(
-      QTOptGraspingModel(), batch_size, k, WARMUP_LOOPS, MEASURE_LOOPS)
+  # --- reference-parity line (comparable with BENCH_r01/r02) ----------
+  parity_sps, parity_flops, parity_bench = _measure_config(
+      QTOptGraspingModel(), parity_batch, k)
+  flops_source = "xla_cost_analysis"
+  if not parity_flops:
+    # ADVICE r2: a cost-analysis failure must not null the contract
+    # keys — fall back to the documented analytic count (a batch-32
+    # figure, scaled: conv FLOPs are linear in batch), loudly.
+    parity_flops = ANALYTIC_PARITY_FLOPS_B32 * parity_batch / 32
+    flops_source = "analytic_fallback(cost_analysis failed)"
+  flops_per_image = parity_flops / parity_batch
 
-  # space_to_depth stem not benched by default: measured 2026-07-30 at
-  # 159 vs 189 steps/s against the parity stem (same warmup/measure
-  # settings) — the 472² 6D transpose's HBM traffic and the 1.8x stem
-  # FLOPs (192- vs 108-feature kernel) outweigh the MXU lane gain on a
-  # stem that is ~18% of total FLOPs. Kept as a model option + test;
-  # negative result recorded in DESIGN.md §8.
+  # --- steady state (dispatch overhead removed, methodology named) ----
+  # Runs immediately after the parity measurement so the k=60
+  # executable is reused, then ALL parity device buffers are dropped
+  # before the batch-128 allocations (the 16 GB HBM cannot hold both
+  # stacked batches at once).
+  parity_marginal_ms, overhead_ms = _steady_state(
+      QTOptGraspingModel(), parity_batch, 20, k, big_bench=parity_bench)
+  del parity_bench
+
+  # --- headline operating point (stated): batch 128, uint8 wire ------
+  headline_batch = 128
+  headline_model = QTOptGraspingModel(uint8_images=True)
+  headline_sps, headline_flops, _ = _measure_config(
+      headline_model, headline_batch, k)
+  headline_img_s = headline_sps * headline_batch
+
+  # --- derived per-image A100 bar -------------------------------------
+  ideal_img_s = A100_FP32_FLOPS / flops_per_image
+  fork_estimate_img_s = ideal_img_s * FORK_FP32_CONV_EFFICIENCY
+  fork_typical_img_s = ideal_img_s * FORK_TYPICAL_E2E_EFFICIENCY
+  vs_baseline = round(headline_img_s / fork_estimate_img_s, 2)
+
+  # --- variants --------------------------------------------------------
   variants = {}
-  for name, kwargs in (
-      ("groupnorm_tower", {"norm": "group"}),
-      ("uint8_wire", {"uint8_images": True}),
-  ):
-    v, r = _measure_model(
-        QTOptGraspingModel(**kwargs), batch_size, k, 1, 2)
-    variants[name] = {"steps_per_sec_per_chip": v, **r}
+  v_f32_128, _, _ = _measure_config(QTOptGraspingModel(), 128, 15,
+                                    warmup=1, measure=2)
+  variants["float32_wire_b128_k15"] = {
+      "steps_per_sec_per_chip": v_f32_128,
+      "images_per_sec_per_chip": round(v_f32_128 * 128),
+      "note": "float32 wire caps k at 15 (stacked batch is 4x larger); "
+              "the uint8 headline's margin over this line is wire "
+              "traffic + dispatch amortization, same conv math"}
+  v_s2d, _, _ = _measure_config(
+      QTOptGraspingModel(uint8_images=True, stem="space_to_depth"),
+      headline_batch, k, warmup=1, measure=2)
+  variants["s2d_folded_stem_b128_uint8"] = {
+      "steps_per_sec_per_chip": v_s2d,
+      "images_per_sec_per_chip": round(v_s2d * headline_batch),
+      "note": "folded space-to-depth stem (ops/stem_conv.py): isolated "
+              "stem fwd+grad_w 1269us vs 1701us parity, but e2e-neutral "
+              "at this operating point — recorded honestly"}
 
-  # Throughput headroom beyond the parity batch: per-chip batch 128
-  # lifts MFU 10.4% → 16.1% (measured 2026-07-30) — larger spatial
-  # tiles per conv dispatch. The headline stays batch 32 (the fork's
-  # per-GPU batch, the comparable); this line documents the knob.
-  # k=15, not the headline's 60: the K-stacked float32 input at batch
-  # 128 is k × 85 MB — 60 × 342 MB ≈ 20 GB would blow the 16 GB HBM,
-  # so dispatch amortization here differs from the headline (a second
-  # variable in the comparison; the MFU figure is what transfers).
-  v128, r128 = _measure_model(
-      QTOptGraspingModel(), 128, 15, 1, 2)
-  variants["batch128"] = {
-      "steps_per_sec_per_chip": v128,
-      "images_per_sec_per_chip": round(v128 * 128),
-      "mfu": r128.get("mfu"),
-  }
+  microbench = _microbench_convs()
 
-  baseline = _derive_baseline(roofline.get("flops_per_step", 0))
-  if baseline:
-    bar = baseline["a100_fork_estimate_steps_per_sec"]
-    vs_baseline = round(value / bar, 3)
-    vs_ideal = round(value / baseline["a100_ideal_bound_steps_per_sec"], 3)
-    vs_typical = round(
-        value / baseline["a100_fork_typical_steps_per_sec"], 3)
+  input_pipeline = _bench_input_pipeline(parity_batch, headline_img_s)
+
+  mfu = None
+  if peak and headline_flops:
+    # headline flops from its own executable (uint8 variant's math).
+    mfu = round(headline_flops * headline_sps / peak, 4)
+  parity_mfu = None
+  if peak and parity_flops:
+    parity_mfu = round(parity_flops * parity_sps / peak, 4)
+    parity_steady_mfu = round(
+        parity_flops / (parity_marginal_ms * 1e-3) / peak, 4)
   else:
-    vs_baseline = vs_ideal = vs_typical = None
+    parity_steady_mfu = None
 
-  input_pipeline = _bench_input_pipeline(
-      QTOptGraspingModel(), batch_size, value)
-
-  print(json.dumps({
-      "metric": f"QTOptGraspingModel train steps/sec/chip "
-                f"(batch {batch_size})",
-      "value": value,
-      "unit": "steps/sec/chip",
-      "vs_baseline": vs_baseline,
-      "vs_a100_ideal_bound": vs_ideal,
-      "vs_fork_typical": vs_typical,
-      "device_kind": jax.devices()[0].device_kind,
+  detail = {
+      "round": 3,
+      "device_kind": device_kind,
       "iterations_per_loop": k,
-      "roofline": roofline,
-      "baseline": baseline,
+      "headline": {
+          "operating_point": f"batch {headline_batch}, uint8 wire, "
+                             f"k={k}, parity architecture (BatchNorm, "
+                             "6x6 conv stem)",
+          "images_per_sec_per_chip": round(headline_img_s),
+          "steps_per_sec_per_chip": headline_sps,
+          "mfu": mfu,
+          "flops_per_step": round(headline_flops),
+      },
+      "parity_b32": {
+          "steps_per_sec_per_chip": parity_sps,
+          "images_per_sec_per_chip": round(parity_sps * parity_batch),
+          "mfu_naive": parity_mfu,
+          "steady_state_ms_per_step": round(parity_marginal_ms, 2),
+          "steady_state_steps_per_sec": round(1e3 / parity_marginal_ms, 1),
+          "mfu_steady": parity_steady_mfu,
+          "per_call_dispatch_overhead_ms": round(overhead_ms, 1),
+          "flops_per_step": round(parity_flops),
+          "flops_source": flops_source,
+          "vs_baseline_steps_basis": round(
+              parity_sps / (fork_estimate_img_s / parity_batch), 2),
+      },
+      "baseline": {
+          "kind": "derived-a100-fp32-compute-roofline, per-image",
+          "flops_per_image": round(flops_per_image),
+          "a100_ideal_bound_img_per_sec": round(ideal_img_s),
+          "a100_fork_estimate_img_per_sec": round(fork_estimate_img_s),
+          "a100_fork_typical_img_per_sec": round(fork_typical_img_s),
+          "assumptions": _BASELINE_ASSUMPTIONS,
+      },
+      "vs_a100_ideal_bound": round(headline_img_s / ideal_img_s, 2),
+      "vs_fork_typical": round(headline_img_s / fork_typical_img_s, 2),
+      "conv_microbench": microbench,
       "variants": variants,
       "input_pipeline": input_pipeline,
+  }
+  with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         DETAIL_FILE), "w") as f:
+    json.dump(detail, f, indent=2)
+
+  print(json.dumps({
+      "metric": "QTOptGraspingModel train images/sec/chip "
+                f"(batch {headline_batch}, uint8 wire, k={k})",
+      "value": round(headline_img_s),
+      "unit": "images/sec/chip",
+      "vs_baseline": vs_baseline,
+      "vs_baseline_tier": "a100_fork_estimate (conservative x0.5)",
+      "parity_b32_steps_per_sec": parity_sps,
+      "mfu": mfu,
+      "flops_per_image": round(flops_per_image),
+      "record_fed_uint8_steps_per_sec": input_pipeline.get(
+          "record_fed_uint8_steps_per_sec"),
+      "device_kind": device_kind,
+      "detail": DETAIL_FILE,
   }))
 
 

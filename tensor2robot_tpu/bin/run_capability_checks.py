@@ -110,8 +110,10 @@ def check_pose_env(scale: str, workdir: str) -> dict:
                            image_size=knobs["image"],
                            success_threshold=0.05,
                            extra_thresholds=(0.10,))
+  # Key derived the same way evaluate_policy builds it (f"{t:g}") so a
+  # 0.10-vs-0.1 formatting drift cannot KeyError.
   return {"success_rate": result["success_rate"],
-          "success_rate_at_0p10": result["success_rate_at_0.1"],
+          "success_rate_at_0p10": result[f"success_rate_at_{0.10:g}"],
           "mean_reward": result["mean_reward"],
           "metric": "reach success within 0.05"}
 

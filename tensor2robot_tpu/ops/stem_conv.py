@@ -1,0 +1,109 @@
+"""Folded space-to-depth stem convolution (TPU-first stem formulation).
+
+The reference's grasping nets open with a big-spatial, 3-channel stem
+conv (reference research/qtopt/t2r_models.py §LegacyGraspingModelQ via
+SURVEY.md §2: Conv 64×(6,6)/4 on a 472² camera image). On TPU that
+layer is badly lane-starved — only 3 of the MXU's input lanes carry
+data per tap — and XLA's direct conv lowering measures ~3% MFU on v5e,
+making the stem ~40% of the whole train step (2026-07-31 microbench,
+docs/DESIGN.md §8).
+
+The space-to-depth stem (the model's documented
+`stem_kind="space_to_depth"` option: 8×8 receptive field, stride 4,
+function class strictly containing the parity stem's 6×6) fixes the
+lane starvation, but the naive 6D block-transpose costs more than it
+saves (BENCH_r02: 159 vs 189 steps/s). This module implements the same
+function WITHOUT any transpose, as ONE standard convolution over a
+reshaped (free) view of the image:
+
+  rows = zero-pad x to (B, H+4, W·C + 4C), viewed as
+         (B, (H+4)·…, W/4 + 1, 4C) — reshapes only, no data movement.
+  y[b, jo, wo, o] = Σ_{r<8, s<2, m<4C}
+      rows[b, 4·jo + r, wo + s, m] · w[r, s, m, o]
+
+i.e. an (8, 2)-kernel, stride-(4, 1), Cin=4C convolution: the
+W-direction phase extraction is folded into the contraction ordering,
+so XLA sees a well-shaped conv (contraction 16·C = 48 for RGB) instead
+of either a 3-channel conv or a 6D transpose. Measured on v5e
+(2026-07-31, batch 32, 472²): stem fwd+grad_w 1269 µs vs 1701 µs for
+the parity 6×6 conv and 2670 µs (fwd alone) for the naive
+space-to-depth — with bit-identical results to the naive formulation
+under the `fold_s2d_weights` weight-layout permutation.
+
+A fully-fused Pallas patches-in-VMEM kernel was attempted and is
+recorded as a negative result: the im2col lane regroup ((J, WO·m) →
+(J, WO, m)) is a lane→sublane redistribution that Mosaic's
+infer-vector-layout rejects ("unsupported shape cast", tested m = 12
+and 16), and every transpose-based workaround either pays per-tile
+relayouts comparable to the XLA folded conv or exceeds the ~16 MB VMEM
+budget at the required tile sizes (J = 59 forced by JO = 118 = 2·59).
+The folded-conv formulation keeps the win inside XLA instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_R = 8  # kernel rows (2 stride-4 row blocks)
+_S = 2  # kernel col-blocks
+
+
+def _geometry(x_shape, w_shape):
+  b, h, w, c = x_shape
+  if h % 4 or w % 4:
+    raise ValueError(f"H and W must be multiples of 4, got {x_shape}")
+  if w_shape[:3] != (_R, _S, 4 * c):
+    raise ValueError(
+        f"weights must be ({_R}, {_S}, {4 * c}, O) for C={c}, got "
+        f"{w_shape}")
+  return b, h // 4, w // 4, c, w_shape[-1]
+
+
+def fold_s2d_weights(w_blocks: jax.Array) -> jax.Array:
+  """(2, 2, 16C, O) block-transpose layout → (8, 2, 4C, O) folded layout.
+
+  The naive space-to-depth formulation reshapes 4×4 blocks to channels
+  (ordering (row_phase p, col_phase q, c)) and applies a (2, 2) conv;
+  its contraction index is (K, L, p, q, c). The folded kernel's is
+  (r = 4K + p, s = L, m = qC + c)."""
+  kh, kw, c16, o = w_blocks.shape
+  if (kh, kw) != (2, 2) or c16 % 16:
+    raise ValueError(f"expected (2, 2, 16C, O), got {w_blocks.shape}")
+  c = c16 // 16
+  wr = w_blocks.reshape(2, 2, 4, 4, c, o)      # K, L, p, q, c, o
+  return wr.transpose(0, 2, 1, 3, 4, 5).reshape(_R, _S, 4 * c, o)
+
+
+def folded_s2d_stem(x: jax.Array, w: jax.Array) -> jax.Array:
+  """Space-to-depth stem conv: (B, H, W, C) → (B, ⌈H/4⌉, ⌈W/4⌉, O).
+
+  Non-multiple-of-4 sizes are zero-padded up first (matching the naive
+  space-to-depth formulation's edge behavior class — the model option
+  predates this op and accepted any size).
+
+  w: (8, 2, 4C, O) folded layout (see module docstring /
+  fold_s2d_weights)."""
+  _, h, wd, _ = x.shape
+  pad_h, pad_w = (-h) % 4, (-wd) % 4
+  if pad_h or pad_w:
+    x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+  b, jo, wo, c, _ = _geometry(x.shape, w.shape)
+  lanes = wo * 4 * c
+  rows = jnp.pad(x.reshape(b, 4 * jo, lanes),
+                 ((0, 0), (0, 4), (0, 4 * c)))
+  folded = rows.reshape(b, 4 * (jo + 1), wo + 1, 4 * c)
+  y = jax.lax.conv_general_dilated(
+      folded, w, window_strides=(4, 1), padding="VALID",
+      dimension_numbers=("NHWC", "HWIO", "NHWC"))
+  assert y.shape == (b, jo, wo, w.shape[-1]), y.shape
+  return y
+
+
+def init_folded_stem_weights(key, c: int, o: int,
+                             dtype=jnp.float32) -> jax.Array:
+  """Lecun-normal init over the (8, 2, 4C, O) folded layout."""
+  fan_in = _R * _S * 4 * c
+  return (jax.random.normal(key, (_R, _S, 4 * c, o)) /
+          np.sqrt(fan_in)).astype(dtype)

@@ -75,7 +75,10 @@ def evaluate_policy(
   }
   distances = -np.asarray(rewards)
   for t in extra_thresholds or ():
-    result[f"success_rate_at_{t}"] = float(np.mean(distances < t))
+    # Deterministic key formatting: float()-coerce then %g, so 0.10,
+    # np.float32(0.1), and 0.1 all produce "success_rate_at_0.1"
+    # (ADVICE r2: str() on a caller-supplied float type is not stable).
+    result[f"success_rate_at_{float(t):g}"] = float(np.mean(distances < t))
   return result
 
 

@@ -93,11 +93,18 @@ class PoseEnv:
                len(DISTRACTOR_COLORS)))]))
     self._occluder = None
     if self._occlusion:
-      # A thin bar crossing near (not through) the target center:
-      # clips an edge of the disc, never the whole object.
+      # A thin bar that only SOMETIMES crosses near the target (clipping
+      # an edge of the disc, never hiding it) and otherwise sits at a
+      # random scene position — an always-near-target bar would be a
+      # deterministic positional beacon a policy could localize instead
+      # of the red disc (ADVICE r2), defeating the clutter's purpose.
       angle = float(self._rng.uniform(0, np.pi))
       offset = float(self._rng.uniform(0.05, 0.09))
-      self._occluder = (self._target.copy(), angle, offset)
+      if self._rng.random() < 0.5:
+        anchor = self._target.copy()
+      else:
+        anchor = self._rng.uniform(-0.9, 0.9, size=2).astype(np.float32)
+      self._occluder = (anchor, angle, offset)
     return self._observation()
 
   def step(self, action: np.ndarray) -> PoseEnvStep:

@@ -30,6 +30,7 @@ from tensor2robot_tpu import modes
 from tensor2robot_tpu.config import configurable
 from tensor2robot_tpu.layers.vision_layers import normalize_image
 from tensor2robot_tpu.models.critic_model import CriticModel
+from tensor2robot_tpu.ops import stem_conv
 from tensor2robot_tpu.preprocessors.image_preprocessors import (
     ImagePreprocessor,
 )
@@ -50,18 +51,17 @@ class _GraspingQModule(nn.Module):
   # not bandwidth-bound.
   norm_kind: str = "batch"
   # "conv" (parity): Conv 64×(6,6)/4 straight on the 3-channel image —
-  # 3 of the MXU's 128 input lanes do work. "space_to_depth": fold each
-  # 4×4 spatial block into channels first (472²×3 → 119²×48, zero-pad
-  # to 476 so block edges align with the conv's SAME window starts),
-  # then Conv 64×(2,2)/1 VALID → the same 118²×64 map from a 48-wide
-  # MXU-friendly matmul. The (2,2)×48 window covers the parity stem's
-  # (6,6) receptive field (8×8 window, stride 4) — same macro-
-  # architecture, strictly larger stem function class, ~16× better
-  # stem lane occupancy. The classic TPU ResNet-stem trick — which
-  # MEASURES SLOWER here (159 vs 189 steps/s, v5e, 2026-07-30): the
-  # full-resolution transpose's HBM traffic plus 1.8× stem FLOPs
-  # outweigh the lane gain on an 18%-of-FLOPs stem. Kept as an option
-  # and a recorded negative result (DESIGN.md §8).
+  # 3 of the MXU's 128 input lanes do work (~3% stem MFU measured,
+  # ~40% of the whole train step). "space_to_depth": the same
+  # block-to-channels idea (8×8 window, stride 4 — covers the parity
+  # stem's (6,6) receptive field; strictly larger stem function
+  # class), implemented via ops/stem_conv.folded_s2d_stem: one
+  # standard (8,2)/(4,1) conv over a reshaped view, NO transpose.
+  # Round 2's naive 6D-transpose space-to-depth measured SLOWER than
+  # parity (159 vs 189 steps/s, v5e, 2026-07-30) because the 472²
+  # transpose outweighed the lane gain; the folded formulation keeps
+  # the lane gain and drops the transpose (stem fwd+grad_w 1269 µs vs
+  # 1701 µs parity, 2026-07-31 — ops/stem_conv.py docstring).
   stem_kind: str = "conv"
 
   @nn.compact
@@ -81,18 +81,13 @@ class _GraspingQModule(nn.Module):
     if self.stem_kind == "conv":
       x = nn.Conv(64, (6, 6), strides=(4, 4), dtype=dtype, name="stem")(x)
     elif self.stem_kind == "space_to_depth":
-      b = 4
-      size = x.shape[1]
-      # One extra zero block on the bottom/right so the 2×2 block
-      # window yields ceil(size/b) outputs — the parity stem's SAME
-      # spatial dims (472→118, 64→16).
-      pad = (-size) % b + b
-      x = jnp.pad(x, ((0, 0), (0, pad), (0, pad), (0, 0)))
-      n, h, w, c = x.shape
-      x = x.reshape(n, h // b, b, w // b, b, c).transpose(
-          0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b, b * b * c)
-      x = nn.Conv(64, (2, 2), strides=(1, 1), padding="VALID",
-                  dtype=dtype, name="stem_s2d")(x)
+      c = x.shape[-1]
+      w_folded = self.param(
+          "stem_s2d_kernel",
+          lambda key: stem_conv.init_folded_stem_weights(key, c, 64))
+      bias = self.param("stem_s2d_bias", nn.initializers.zeros, (64,))
+      x = (stem_conv.folded_s2d_stem(x, w_folded.astype(dtype))
+           + bias.astype(dtype))
     else:
       raise ValueError(f"Unknown stem_kind {self.stem_kind!r}")
     x = nn.relu(norm("stem_bn")(x))
@@ -143,6 +138,7 @@ class QTOptGraspingModel(CriticModel):
                uint8_images: bool = False,
                norm: str = "batch",
                stem: str = "conv",
+               wire_format: str = "jpeg",
                **kwargs):
     """state_size > 0 adds a proprioceptive `state` vector feature
     (gripper status etc., reference's non-image state).
@@ -152,10 +148,19 @@ class QTOptGraspingModel(CriticModel):
     4x less host→device and robot→predictor bandwidth for identical
     math. Changes the serving signature — robots send uint8.
 
+    wire_format: how images arrive in tf.Example records — "jpeg"
+    (reference parity: encoded, host-decoded) or "raw" (the image
+    tensor's own bytes, zero decode cost; 472²×3 ≈ 668 KB/record vs
+    ~16 KB JPEG — the trade robots make when host CPU, not disk or
+    network, bounds the pipeline).
+
     norm: "batch" (reference parity) or "group"; stem: "conv" (parity)
     or "space_to_depth" (MXU-friendly stem lanes) — see
     _GraspingQModule field docs."""
     super().__init__(**kwargs)
+    if wire_format not in ("jpeg", "raw"):
+      raise ValueError(f"wire_format must be 'jpeg' or 'raw', got "
+                       f"{wire_format!r}")
     self._image_size = image_size
     self._in_image_size = in_image_size or image_size
     self._action_size = action_size
@@ -164,6 +169,7 @@ class QTOptGraspingModel(CriticModel):
     self._image_dtype = np.uint8 if uint8_images else np.float32
     self._norm = norm
     self._stem = stem
+    self._wire_format = wire_format
 
   def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
     del mode
@@ -192,7 +198,7 @@ class QTOptGraspingModel(CriticModel):
         label_spec=self.get_label_specification(modes.TRAIN),
         image_key="image",
         in_image_shape=(self._in_image_size, self._in_image_size, 3),
-        data_format="jpeg",
+        data_format=None if self._wire_format == "raw" else "jpeg",
         distort=self._distort,
     )
 

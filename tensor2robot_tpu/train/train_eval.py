@@ -564,7 +564,25 @@ def continuous_eval_model(
       pending, timed_out = agree_on_pending(pending, timed_out)
       for step in pending:  # every checkpoint, oldest first — no holes
         last_new_checkpoint = time.monotonic()
-        state = checkpoint_manager.restore(template, step=step)
+        # Multi-host: the step list is the primary's broadcast view —
+        # the sync exists precisely because per-host directory listings
+        # lag on shared storage, so a follower may be told about a step
+        # its own filesystem view doesn't show yet. Re-list and retry
+        # with bounded backoff before failing the eval job.
+        state = None
+        for attempt in range(5):
+          try:
+            state = checkpoint_manager.restore(template, step=step)
+            break
+          except FileNotFoundError:
+            if not multi_host or attempt == 4:
+              raise
+            _log.info(
+                "continuous eval: step %d not visible yet on this host "
+                "(attempt %d); re-listing after backoff", step,
+                attempt + 1)
+            time.sleep(min(2.0 ** attempt, 10.0))
+            checkpoint_manager.reload()
         metrics, images = _evaluate(trainer, model, input_generator_eval,
                                     state, eval_steps, prefetch_depth)
         results[step] = metrics

@@ -184,8 +184,12 @@ class TestBatchJpegDecode:
     if lib is None or not lib.has_batch_decode:
       pytest.skip("native library unavailable")
     images, _ = self._jpegs(n=2, size=32)
-    _, statuses = lib.jpeg_decode_batch(images, 64, 64, 3)
+    out, statuses = lib.jpeg_decode_batch(images, 64, 64, 3)
     assert (statuses == -2).all()
+    # The output buffer is np.empty (not pre-zeroed) since 2026-07-31;
+    # the zeroed-failed-slot contract must hold for the -2 path too —
+    # it is enforced by a memset inside the C++ worker.
+    assert (out == 0).all()
 
   def test_empty_batch(self):
     lib = native.get_native()

@@ -210,3 +210,76 @@ class TestDispatch:
     assert _supported(big, big) is not None  # exceeds VMEM budget
     with pytest.raises(ValueError, match="VMEM"):
       flash_attention(big, big, big, implementation="pallas")
+
+
+class TestFoldedS2dStem:
+  """ops/stem_conv: the folded space-to-depth stem must compute exactly
+  the naive block-transpose space-to-depth function (under the
+  fold_s2d_weights layout permutation) — same function class the model
+  documented in round 2, minus the 6D transpose."""
+
+  @staticmethod
+  def _naive_s2d(x, w_blocks):
+    b = 4
+    size = x.shape[1]
+    pad = (-size) % b + b
+    xp = jnp.pad(x, ((0, 0), (0, pad), (0, pad), (0, 0)))
+    n, h, wd, c = xp.shape
+    xs = xp.reshape(n, h // b, b, wd // b, b, c).transpose(
+        0, 1, 3, 2, 4, 5).reshape(n, h // b, wd // b, b * b * c)
+    return jax.lax.conv_general_dilated(
+        xs, w_blocks, (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+  def test_matches_naive_space_to_depth(self):
+    from tensor2robot_tpu.ops import stem_conv
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 64, 64, 3)), jnp.float32)
+    w_blocks = jnp.asarray(rng.standard_normal((2, 2, 48, 16)) * 0.1,
+                           jnp.float32)
+    expected = self._naive_s2d(x, w_blocks)
+    got = stem_conv.folded_s2d_stem(x, stem_conv.fold_s2d_weights(w_blocks))
+    assert got.shape == expected.shape == (2, 16, 16, 16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=1e-4)
+
+  def test_grad_matches_naive(self):
+    from tensor2robot_tpu.ops import stem_conv
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 16, 16, 3)), jnp.float32)
+    w_blocks = jnp.asarray(rng.standard_normal((2, 2, 48, 8)) * 0.1,
+                           jnp.float32)
+
+    def loss_naive(w):
+      return jnp.sum(self._naive_s2d(x, w) ** 2)
+
+    def loss_folded(w):
+      return jnp.sum(
+          stem_conv.folded_s2d_stem(x, stem_conv.fold_s2d_weights(w)) ** 2)
+
+    g_naive = jax.grad(loss_naive)(w_blocks)
+    g_folded = jax.grad(loss_folded)(w_blocks)
+    np.testing.assert_allclose(np.asarray(g_folded), np.asarray(g_naive),
+                               rtol=1e-4, atol=1e-4)
+
+  def test_geometry_validation(self):
+    from tensor2robot_tpu.ops import stem_conv
+    with pytest.raises(ValueError, match="weights"):
+      stem_conv.folded_s2d_stem(
+          jnp.zeros((1, 32, 32, 3)), jnp.zeros((8, 2, 16, 4)))
+
+  def test_init_shape_and_scale(self):
+    from tensor2robot_tpu.ops import stem_conv
+    w = stem_conv.init_folded_stem_weights(jax.random.key(0), 3, 64)
+    assert w.shape == (8, 2, 12, 64)
+    # Lecun-normal: std ≈ 1/sqrt(fan_in 192)
+    assert 0.5 / np.sqrt(192) < float(jnp.std(w)) < 2.0 / np.sqrt(192)
+
+  def test_non_multiple_of_4_sizes_pad(self):
+    # Regression (r3 review): the naive space-to-depth formulation
+    # accepted any size; the folded op must too, via zero-pad up.
+    from tensor2robot_tpu.ops import stem_conv
+    x = jnp.ones((1, 30, 30, 3), jnp.float32)
+    w = stem_conv.init_folded_stem_weights(jax.random.key(0), 3, 8)
+    y = stem_conv.folded_s2d_stem(x, w)
+    assert y.shape == (1, 8, 8, 8)  # ceil(30/4) = 8
