@@ -45,17 +45,23 @@ _SCALES = {
 # slack) from the committed r2 runs on cluttered scenes
 # (CAPABILITY_r02_full.jsonl / CAPABILITY_r02_fast.jsonl, one v5e,
 # 2026-07-30): pose_env 0.765 fast / 0.925 full (tight 0.05 gate),
-# qtopt 0.47/0.85 (random 0.05), grasp2vec 0.453/0.734 (chance 0.016),
-# vrgripper 0.86/0.95. maml measured 1.0 at both scales — its adapted
-# success saturates by construction (the historical failure mode, the
-# BN-statistics contract, collapses it to the ~0.02 unadapted rate, so
-# a 0.9 bar still catches every real regression ever observed).
+# qtopt 0.47/0.85 (random 0.05), grasp2vec 0.453/0.734 (chance 0.016).
+# vrgripper: recalibrated r3 — the r3 pose_env occluder randomization
+# hardened its training scenes (measured r3: 0.75 fast / 0.925 full vs
+# 0.86/0.95 at r2), so the bars moved to keep the 10-15% slack
+# (CAPABILITY_r03_*.jsonl, 2026-07-31). maml: recalibrated r3 (VERDICT r2 #6 — the old
+# gate was saturated at 1.0): noisy-demonstrations regime (sigma=0.22
+# condition labels) scored at half the object radius measured 0.879
+# fast / 0.922 full (one v5e, 2026-07-31), so the gate now sits in the
+# sensitive region with the usual 10-15% slack; a secondary
+# adapted-vs-unadapted margin assertion (>=0.5 at the object radius)
+# still catches the historical total-collapse failure mode.
 _EXPECT = {
     ("pose_env", "fast"): 0.65, ("pose_env", "full"): 0.80,
     ("qtopt", "fast"): 0.40, ("qtopt", "full"): 0.72,
     ("grasp2vec", "fast"): 0.38, ("grasp2vec", "full"): 0.62,
-    ("vrgripper", "fast"): 0.75, ("vrgripper", "full"): 0.85,
-    ("maml", "fast"): 0.90, ("maml", "full"): 0.95,
+    ("vrgripper", "fast"): 0.65, ("vrgripper", "full"): 0.80,
+    ("maml", "fast"): 0.75, ("maml", "full"): 0.80,
 }
 
 
@@ -237,6 +243,16 @@ def check_maml(scale: str, workdir: str) -> dict:
 
   knobs = _SCALES["maml"][scale]
   k_c = k_i = 4
+  # Noisy demonstrations (meta_reaching.sample_meta_batch docstring):
+  # condition labels jittered at BOTH train and eval by sigma = the
+  # object radius (0.22; objects are >=0.48 apart). Measured r3
+  # calibration path: with clean labels OR sigma=0.10 the check
+  # saturates at 1.0 — the position comes from vision, label noise
+  # only matters once it can flip which object the condition evidence
+  # points at. At sigma=0.22 a fraction of tasks carry genuinely
+  # misleading demonstrations, so success measures how well the
+  # adapted policy integrates K noisy examples — a graded signal.
+  noise = 0.22
 
   def build(num_inner_steps):
     return pose_env_maml_model(
@@ -250,23 +266,47 @@ def check_maml(scale: str, workdir: str) -> dict:
   state = trainer.create_train_state()
   for step in range(knobs["steps"]):
     meta, _ = mr.sample_meta_batch(8, k_c, k_i, image_size=knobs["image"],
-                                   seed=100_000 + step)
+                                   seed=100_000 + step,
+                                   condition_label_noise=noise)
     feats = trainer.shard_batch(jax.tree_util.tree_map(jnp.asarray, meta))
     state, _ = trainer.train_step(state, feats, None)
-  meta, info = mr.sample_meta_batch(32, k_c, k_i,
-                                    image_size=knobs["image"], seed=9999)
+  meta, info = mr.sample_meta_batch(64, k_c, k_i,
+                                    image_size=knobs["image"], seed=9999,
+                                    condition_label_noise=noise)
   feats = jax.tree_util.tree_map(jnp.asarray, meta)
   variables = jax.device_get(state.variables())
 
-  def score(m_eval):
+  def predictions(m_eval):
     out, _ = m_eval.inference_network_fn(variables, feats, "eval")
-    return mr.reach_success(
-        np.asarray(out["inference_output"], np.float32), info)
+    return np.asarray(out["inference_output"], np.float32)
 
-  adapted = score(model)
-  unadapted = score(build(0))
-  return {"success_rate": adapted["success_rate"],
-          "unadapted_success_rate": unadapted["success_rate"]}
+  # Gate on a TIGHT reach radius (same design as the pose_env check):
+  # at the full object radius (0.22) adapted success saturates — so the
+  # gate would only catch the total-collapse failure mode. Half the
+  # object radius under the sigma=0.22 condition noise above lands the
+  # measured figure in the sensitive region (see _EXPECT), so subtler
+  # adaptation-quality regressions move the gated number. The 0.22
+  # figure (same predictions, re-bucketed) and the adapted-vs-unadapted
+  # margin are also emitted; the margin is asserted as a secondary
+  # check.
+  tight = mr.OBJECT_RADIUS / 2
+  adapted_preds = predictions(model)  # one adaptation+forward pass,
+  # scored at both radii (the full inference over 64 tasks is the
+  # expensive part, not the bucketing).
+  adapted = mr.reach_success(adapted_preds, info, radius=tight)
+  adapted_full = mr.reach_success(adapted_preds, info,
+                                  radius=mr.OBJECT_RADIUS)
+  unadapted = mr.reach_success(predictions(build(0)), info,
+                               radius=mr.OBJECT_RADIUS)
+  margin_ok = (adapted_full["success_rate"]
+               >= unadapted["success_rate"] + 0.5)
+  return {"success_rate": (adapted["success_rate"] if margin_ok
+                           else 0.0),
+          "success_rate_at_object_radius": adapted_full["success_rate"],
+          "unadapted_success_rate": unadapted["success_rate"],
+          "adapted_vs_unadapted_margin_ok": margin_ok,
+          "metric": f"query reach within {tight:g} (half object "
+                    "radius), gated on adapted-unadapted margin"}
 
 
 _CHECKS = {

@@ -218,6 +218,27 @@ class TestMetaReaching:
             np.float32), info)
     assert rand["success_rate"] < 0.3
 
+  def test_condition_label_noise_semantics(self):
+    """Noisy-demonstrations regime (r3 MAML gate calibration): noise
+    jitters CONDITION labels only — query labels (the meta-train outer
+    target) and the scoring ground truth stay exact."""
+    from tensor2robot_tpu.research.pose_env import meta_reaching as mr
+    clean, info_c = mr.sample_meta_batch(4, 3, 2, image_size=32, seed=7)
+    noisy, info_n = mr.sample_meta_batch(4, 3, 2, image_size=32, seed=7,
+                                         condition_label_noise=0.1)
+    cond_delta = np.abs(
+        np.asarray(noisy["condition/labels/target_pose"])
+        - np.asarray(clean["condition/labels/target_pose"]))
+    assert cond_delta.max() > 0.01  # condition labels jittered
+    np.testing.assert_array_equal(
+        np.asarray(noisy["inference/labels/target_pose"]),
+        np.asarray(clean["inference/labels/target_pose"]))
+    np.testing.assert_array_equal(info_n["query_target"],
+                                  info_c["query_target"])
+    # The oracle still scores 1.0 against exact ground truth.
+    assert mr.reach_success(
+        info_n["query_target"], info_n)["success_rate"] == 1.0
+
   def test_maml_base_defaults_to_stateless_norm(self):
     """MAML's inner loop never collects BN running statistics, so a
     BatchNorm base serves with init stats (measured: meta-train outer

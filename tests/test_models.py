@@ -308,6 +308,7 @@ class TestPreprocessors:
       apply_photometric_distortions(
           np.zeros((1, 4, 4, 3), np.uint8), rng)
 
+  @pytest.mark.slow  # fast-lane budget (VERDICT r3 #8): covered by the full suite; TF-comparison math is frozen; the distortion path itself is exercised fast
   def test_distortion_math_matches_tf(self):
     """adjust_saturation must be the HSV scale tf.image does, and contrast
     must scale around the per-channel mean like tf.image.adjust_contrast.

@@ -114,6 +114,9 @@ class TestUlyssesAttention:
         np.asarray(out, np.float32), np.asarray(expected, np.float32),
         atol=0.05)
 
+  @pytest.mark.slow  # fast-lane budget (VERDICT r3 #8): all_to_all's
+  # transpose is all_to_all (low-risk vjp); ring's rotated-carry grad
+  # test — the risky one — stays in the fast lane.
   def test_gradients_flow(self):
     mesh = create_mesh({"seq": -1})
     q, k, v = _qkv(t=16, h=8)
@@ -295,6 +298,7 @@ class TestExpertParallel:
     zero_rows = np.sum(~np.any(np.asarray(out) != 0.0, axis=-1))
     assert zero_rows >= 16 - 4
 
+  @pytest.mark.slow  # fast-lane budget (VERDICT r3 #8): covered by the full suite; EP forward/dense-equivalence tests stay fast
   def test_gradients_flow_through_ep(self):
     tokens, params = self._setup()
     mesh = create_mesh({"expert": -1})
@@ -337,6 +341,7 @@ class TestSequenceParallelSnail:
     np.testing.assert_allclose(np.asarray(out_ring),
                                np.asarray(out_dense), atol=2e-5)
 
+  @pytest.mark.slow  # fast-lane budget (VERDICT r3 #8): covered by the full suite; the single-axis ring-vs-dense snail test stays fast
   def test_snail_attention_ring_dp_sp_mesh(self):
     # On a dp×sp mesh, batch_axis shards the batch over the data rows
     # (without it each row would all-gather and redo the whole batch).
