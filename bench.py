@@ -272,11 +272,13 @@ def _microbench_convs():
     table[name] = entry
   table["note"] = (
       "delta method (two scan lengths) — per-op marginal cost, no "
-      "dispatch overhead. 64-ch tower convs reach 36%/76% MFU at "
-      "b32/b128 in isolation and ~90% at 128 channels; the "
-      "3-input-channel parity stem ~3%. The end-to-end MFU ceiling is "
-      "the parity architecture's lane structure (Cin=3 stem, Cout=64 "
-      "tower), not scheduling loss.")
+      "dispatch overhead. Read the measured MFU from the fields above "
+      "(they are re-measured every run and vary run-to-run on the "
+      "shared tunnel chip); the stable pattern is that the 64-channel "
+      "tower convs sit far above the 3-input-channel parity stem, and "
+      "128 input channels approach the MXU roofline — the end-to-end "
+      "MFU ceiling is the parity architecture's lane structure (Cin=3 "
+      "stem, Cout=64 tower), not scheduling loss.")
   return table
 
 

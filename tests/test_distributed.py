@@ -249,6 +249,104 @@ assert any(
     "no param picked up a model-axis TP sharding")
 run_sharded_train_step(hybrid, tp_specs, "dp-tp-hybrid")
 
+# Sequence parallelism ACROSS PROCESSES: the seq axis spans both hosts
+# (2 processes x 2 local devices = 4-way SP over the DCN tier) — the
+# long-context path exercised with REAL cross-process collectives, not
+# just the single-process 8-device CPU mesh. Both hosts know the full
+# input (same seeded rng); each feeds its process-local sequence shard
+# and verifies its addressable output shards against the dense
+# reference computed host-side.
+from tensor2robot_tpu.parallel import (dense_attention_reference,
+                                       ring_attention, ulysses_attention)
+
+sp_mesh = mesh_lib.create_mesh({"seq": -1})  # 4 devices over 2 procs
+sp_rng = np.random.default_rng(42)
+B, T, H, D = 2, 16, 4, 8
+qkv_host = [np.asarray(sp_rng.standard_normal((B, T, H, D)),
+                       np.float32) * 0.5 for _ in range(3)]
+seq_sharding = NamedSharding(sp_mesh, PartitionSpec(None, "seq"))
+t_lo = process_id * (T // 2)
+
+
+def to_global(x):
+  return jax.make_array_from_process_local_data(
+      seq_sharding, x[:, t_lo:t_lo + T // 2], global_shape=x.shape)
+
+
+qg, kg, vg = (to_global(x) for x in qkv_host)
+expected = np.asarray(dense_attention_reference(
+    jnp.asarray(qkv_host[0]), jnp.asarray(qkv_host[1]),
+    jnp.asarray(qkv_host[2]), causal=True))
+for name, fn in (("ring", ring_attention), ("ulysses", ulysses_attention)):
+  out = jax.jit(
+      lambda q, k, v, f=fn: f(q, k, v, sp_mesh, axis="seq", causal=True)
+  )(qg, kg, vg)
+  for shard in out.addressable_shards:
+    got = np.asarray(shard.data)
+    want = expected[shard.index]
+    err = float(np.max(np.abs(got - want)))
+    assert err < 2e-4, f"cross-process {name} SP mismatch: {err}"
+distributed.sync_global_devices("cross_process_sp_done")
+
+# Expert and pipeline parallelism across processes: the MoE all_to_all
+# dispatch and the GPipe ppermute ride the cross-process (DCN) links.
+# Replicated operands must still be GLOBAL arrays in multi-process JAX —
+# each host contributes the identical full value.
+from tensor2robot_tpu.parallel import (expert_parallel_moe,
+                                       init_moe_params, pipeline_apply,
+                                       stack_stage_params, switch_moe)
+
+
+def replicate(mesh, tree):
+  sharding = mesh_lib.replicated_sharding(mesh)
+  return jax.tree_util.tree_map(
+      lambda x: jax.make_array_from_process_local_data(
+          sharding, np.asarray(x), global_shape=np.shape(x)), tree)
+
+
+ep_mesh = mesh_lib.create_mesh({"expert": -1})  # 4 experts over 2 procs
+moe_params_host = jax.device_get(init_moe_params(
+    jax.random.key(0), num_experts=4, d_model=8, d_hidden=16))
+tokens_host = np.asarray(sp_rng.standard_normal((16, 8)), np.float32)
+out_dense, _ = switch_moe(jnp.asarray(tokens_host),
+                          jax.tree_util.tree_map(jnp.asarray,
+                                                 moe_params_host),
+                          capacity=16)
+out_dense = np.asarray(out_dense)
+tokens_g = replicate(ep_mesh, tokens_host)
+params_g = replicate(ep_mesh, moe_params_host)
+out_ep, _ = jax.jit(
+    lambda t, p: expert_parallel_moe(t, p, ep_mesh, capacity=16)
+)(tokens_g, params_g)
+for shard in out_ep.addressable_shards:
+  err = float(np.max(np.abs(np.asarray(shard.data)
+                            - out_dense[shard.index])))
+  assert err < 1e-4, f"cross-process EP mismatch: {err}"
+distributed.sync_global_devices("cross_process_ep_done")
+
+pp_mesh = mesh_lib.create_mesh({"stage": -1})  # 4 stages over 2 procs
+pp_rng = np.random.default_rng(7)
+width = 8
+stage_params_host = [
+    {"w": np.asarray(pp_rng.standard_normal((width, width)) * 0.3,
+                     np.float32)} for _ in range(4)]
+stage_fn = lambda p, x: jnp.tanh(x @ p["w"])
+x_host = np.asarray(pp_rng.standard_normal((8, width)), np.float32)
+expected_pp = x_host
+for p in stage_params_host:
+  expected_pp = np.asarray(stage_fn(
+      jax.tree_util.tree_map(jnp.asarray, p), jnp.asarray(expected_pp)))
+stacked_host = jax.device_get(stack_stage_params(
+    [jax.tree_util.tree_map(jnp.asarray, p) for p in stage_params_host]))
+out_pp = jax.jit(
+    lambda sp, x: pipeline_apply(sp, x, stage_fn, pp_mesh, axis="stage")
+)(replicate(pp_mesh, stacked_host), replicate(pp_mesh, x_host))
+for shard in out_pp.addressable_shards:
+  err = float(np.max(np.abs(np.asarray(shard.data)
+                            - expected_pp[shard.index])))
+  assert err < 1e-4, f"cross-process PP mismatch: {err}"
+distributed.sync_global_devices("cross_process_pp_done")
+
 distributed.sync_global_devices("test_done")
 print(f"WORKER{process_id}_OK primary={distributed.is_primary()}")
 """
