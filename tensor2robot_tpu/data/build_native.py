@@ -7,6 +7,7 @@ implementations when it is absent or fails to build.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 import sys
@@ -14,6 +15,30 @@ import sys
 _THIS_DIR = os.path.dirname(os.path.abspath(__file__))
 SOURCE = os.path.join(_THIS_DIR, "_native", "native_data.cc")
 LIBRARY = os.path.join(_THIS_DIR, "_native", "libt2rnative.so")
+# Sidecar recording the sha256 of the source the .so was built from.
+# Staleness is decided by content hash, NOT mtime ordering: a copied or
+# touched .so artifact can carry an mtime newer than an updated source
+# while holding pre-update code (ADVICE r3) — with the old mtime rule it
+# would be trusted and could violate newer ABI contracts (e.g. return
+# uninitialized memory for failure modes the update started zeroing).
+HASH_SIDECAR = LIBRARY + ".srchash"
+
+
+def source_hash() -> str:
+  with open(SOURCE, "rb") as f:
+    return hashlib.sha256(f.read()).hexdigest()
+
+
+def library_is_current() -> bool:
+  """True iff the built .so exists and matches the current source."""
+  if not os.path.exists(LIBRARY):
+    return False
+  try:
+    with open(HASH_SIDECAR) as f:
+      recorded = f.read().strip()
+  except OSError:
+    return False  # no provenance record → rebuild
+  return recorded == source_hash()
 
 
 def build(verbose: bool = True) -> str:
@@ -26,6 +51,8 @@ def build(verbose: bool = True) -> str:
   if result.returncode != 0:
     raise RuntimeError(
         f"native build failed:\n{result.stderr[-2000:]}")
+  with open(HASH_SIDECAR, "w") as f:
+    f.write(source_hash() + "\n")
   if verbose:
     print(f"Built {LIBRARY}")
   return LIBRARY

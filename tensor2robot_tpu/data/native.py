@@ -245,10 +245,9 @@ def get_native(auto_build: bool = True) -> Optional[NativeData]:
       return None
     from tensor2robot_tpu.data import build_native
     try:
-      stale = (os.path.exists(build_native.LIBRARY)
-               and os.path.getmtime(build_native.SOURCE)
-               > os.path.getmtime(build_native.LIBRARY))
-      if (not os.path.exists(build_native.LIBRARY) or stale) and auto_build:
+      # Content-hash staleness (ADVICE r3): the .so is trusted only if
+      # its recorded source sha256 matches the source on disk.
+      if not build_native.library_is_current() and auto_build:
         build_native.build(verbose=False)
       _native = NativeData(ctypes.CDLL(build_native.LIBRARY))
     except Exception as e:  # missing toolchain/libjpeg → Python path

@@ -372,6 +372,103 @@ class TestRecordInputGenerator:
     assert not leaked, f"leaked pipeline threads: {leaked}"
 
 
+class TestNativeMode:
+  """native_mode policy: pinning, auto-calibration, stats reporting.
+
+  The path choice is pure speed policy (both parsers are bit-exact —
+  TestExampleParser / tests/test_native.py), so these tests assert the
+  POLICY: the decision is recorded, honored, and order-preserving."""
+
+  @pytest.fixture
+  def record_files(self, tmp_path):
+    paths = []
+    for i in range(4):
+      path = str(tmp_path / f"train-{i:02d}.tfrecord")
+      tfrecord.write_tfrecords(
+          path, [_make_record(pose=(i, j)) for j in range(8)])
+      paths.append(path)
+    return str(tmp_path / "train-*.tfrecord")
+
+  def test_invalid_mode_rejected(self, record_files):
+    with pytest.raises(ValueError, match="native_mode"):
+      DefaultRecordInputGenerator(record_files, native_mode="fastest")
+
+  @pytest.mark.parametrize("mode_opt", ["native", "python"])
+  def test_pinned_mode_recorded(self, record_files, mode_opt):
+    gen = DefaultRecordInputGenerator(record_files, batch_size=4,
+                                      native_mode=mode_opt)
+    gen.set_specification(_feature_spec(), _label_spec())
+    it = gen.create_dataset_fn("eval")()
+    next(it)
+    it.close()
+    cal = gen.pipeline_stats["native_calibration"]
+    assert cal["decision"] == mode_opt
+    assert cal["reason"] == "pinned by native_mode"
+
+  def test_auto_calibrates_and_preserves_records(self, record_files):
+    """Auto mode must time both arms, pin a winner, and feed every
+    peeled record back into the stream (single-pass eval count check)."""
+    gen = DefaultRecordInputGenerator(record_files, batch_size=4,
+                                      native_mode="auto")
+    # Dense-only spec → the native plan applies and auto really times
+    # both arms (the full _feature_spec has varlen/png routes, which
+    # pin python without measuring — covered separately below).
+    gen.set_specification(
+        {"pose": ExtendedTensorSpec((2,), np.float32, name="pose")},
+        _label_spec())
+    batches = list(gen.create_dataset_fn("eval")())
+    assert len(batches) == 8  # 32 records / 4 — nothing dropped
+    cal = gen.pipeline_stats["native_calibration"]
+    assert cal["decision"] in ("native", "python")
+    from tensor2robot_tpu.data import native
+    if native.get_native() is not None:
+      assert cal["reason"] == "calibrated"
+      assert cal["native_batch_s"] > 0 and cal["python_batch_s"] > 0
+      assert cal["trials"] == 2
+
+  def test_auto_with_unbatchable_spec_pins_python(self, record_files):
+    """Specs the native plan can't cover (varlen) must calibrate
+    straight to python with the reason recorded, not time a path that
+    would fall back anyway."""
+    from tensor2robot_tpu.data import native
+    if native.get_native() is None:
+      pytest.skip("native library unavailable")
+    gen = DefaultRecordInputGenerator(record_files, batch_size=4,
+                                      native_mode="auto")
+    gen.set_specification(_feature_spec(), _label_spec())
+    # _feature_spec includes a varlen sequence feature → no native plan.
+    it = gen.create_dataset_fn("eval")()
+    next(it)
+    it.close()
+    cal = gen.pipeline_stats["native_calibration"]
+    if cal["reason"] != "calibrated":
+      assert cal["decision"] == "python"
+
+  def test_tiny_dataset_skips_calibration(self, tmp_path):
+    path = str(tmp_path / "tiny.tfrecord")
+    tfrecord.write_tfrecords(path, [_make_record() for _ in range(3)])
+    gen = DefaultRecordInputGenerator(path, batch_size=8,
+                                      native_mode="auto")
+    gen.set_specification(_feature_spec(), _label_spec())
+    batches = list(gen.create_dataset_fn("eval")())
+    assert batches == []  # drop_remainder: < 1 batch
+    cal = gen.pipeline_stats["native_calibration"]
+    assert "not calibrated" in cal["reason"]
+
+  def test_parser_calibrate_native_pins_winner(self):
+    parser = ExampleParser(
+        {"pose": ExtendedTensorSpec((2,), np.float32, name="pose")})
+    records = [_make_record() for _ in range(4)]
+    stats = parser.calibrate_native(records, trials=2)
+    assert stats["decision"] in ("native", "python")
+    # The pin must actually steer parse_batch (python pin → native lib
+    # never consulted; monkeypatching get_native would hide real calls,
+    # so assert via the flag contract instead).
+    parser.set_native_enabled(False)
+    features, _ = parser.parse_batch(records)
+    assert features["pose"].shape == (4, 2)
+
+
 class TestPrefetch:
 
   def test_prefetch_to_device(self):

@@ -394,3 +394,38 @@ class TestExampleParseParity:
     feats, labels = p.parse_batch(records)
     feats["pose"][0, 0] = 99.0
     assert labels["pose"][0, 0] == 1.0
+
+
+class TestBuildCache:
+  """Staleness is content-hash keyed (ADVICE r3): a .so whose mtime is
+  newer than the source but whose recorded source hash mismatches must
+  be treated as stale — mtime ordering says nothing about provenance."""
+
+  def test_current_library_matches_hash(self):
+    from tensor2robot_tpu.data import build_native
+    if not os.path.exists(build_native.LIBRARY):
+      pytest.skip("native library not built")
+    assert build_native.library_is_current()
+
+  def test_missing_sidecar_means_stale(self, monkeypatch, tmp_path):
+    from tensor2robot_tpu.data import build_native
+    fake_lib = tmp_path / "lib.so"
+    fake_lib.write_bytes(b"not a real so")
+    monkeypatch.setattr(build_native, "LIBRARY", str(fake_lib))
+    monkeypatch.setattr(build_native, "HASH_SIDECAR",
+                        str(fake_lib) + ".srchash")
+    assert not build_native.library_is_current()
+
+  def test_hash_mismatch_means_stale_despite_newer_mtime(
+      self, monkeypatch, tmp_path):
+    from tensor2robot_tpu.data import build_native
+    fake_lib = tmp_path / "lib.so"
+    fake_lib.write_bytes(b"artifact built from older source")
+    sidecar = tmp_path / "lib.so.srchash"
+    sidecar.write_text("0" * 64 + "\n")  # hash of some OTHER source
+    monkeypatch.setattr(build_native, "LIBRARY", str(fake_lib))
+    monkeypatch.setattr(build_native, "HASH_SIDECAR", str(sidecar))
+    # mtime ordering would call this fresh; the hash says otherwise.
+    now = time.time()
+    os.utime(fake_lib, (now + 100, now + 100))
+    assert not build_native.library_is_current()

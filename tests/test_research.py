@@ -365,8 +365,12 @@ class TestRawUint8Wire:
       model = QTOptGraspingModel(image_size=size, in_image_size=size,
                                  uint8_images=True, wire_format="raw",
                                  optimizer_fn=lambda: optax.adam(1e-3))
-      gen = DefaultRecordInputGenerator(file_patterns=rec, batch_size=8,
-                                        seed=0)
+      # native_mode pinned (not "auto"): this test's claim is that the
+      # NAMED path handled the records; calibration could silently pick
+      # the other one.
+      gen = DefaultRecordInputGenerator(
+          file_patterns=rec, batch_size=8, seed=0,
+          native_mode="python" if disable_native else "native")
       gen.set_specification_from_model(model, modes.TRAIN)
       it = gen.create_dataset_fn(modes.TRAIN)()
       features, labels = next(it)
