@@ -63,6 +63,21 @@ class CheckpointManager:
       step = self.latest_step()
     if step is None:
       raise FileNotFoundError(f"No checkpoint in {self.directory}")
+    # Visibility probe BEFORE delegating: orbax's restore() latches its
+    # default-item mode from the step directory's layout the first time
+    # it runs (`_default_item.set_if_none`) — including a FAILED
+    # premature restore on a step dir that is not there yet (lagging
+    # follower view), which latches the WRONG mode permanently and
+    # turns every subsequent StandardRestore into a Composite-args
+    # ValueError even after the checkpoint appears. Raising the
+    # FileNotFoundError ourselves keeps the manager un-poisoned so the
+    # caller's reload/backoff retry can actually succeed (observed with
+    # the in-image orbax; regression-tested in
+    # tests/test_train_eval.py §TestRestoreWithRetry).
+    item_dir = os.path.join(self.directory, str(step), "default")
+    if not os.path.isdir(item_dir):
+      raise FileNotFoundError(
+          f"Checkpoint step {step} not (fully) visible at {item_dir}")
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, state)
     return self._manager.restore(step, args=ocp.args.StandardRestore(abstract))
 
@@ -78,6 +93,17 @@ class CheckpointManager:
     directory another process writes (the continuous evaluator) must
     reload before each poll."""
     self._manager.reload()
+    # Belt to the restore() probe's braces: if a premature restore DID
+    # latch the default-item mode from a half-visible dir, clear it so
+    # the next restore re-determines it from the real layout. Private
+    # attribute, hence the defensive getattr — on an orbax without it,
+    # the probe above alone still prevents the poisoning.
+    default_item = getattr(self._manager, "_default_item", None)
+    if default_item is not None and hasattr(default_item, "set"):
+      try:
+        default_item.set(None)
+      except Exception:  # never let a cache clear break a poll
+        pass
 
   def wait(self) -> None:
     self._manager.wait_until_finished()

@@ -484,6 +484,45 @@ def _evaluate(trainer, model, input_generator_eval, state,
   return metrics, images
 
 
+# Errors a FOLLOWER can see for a step that exists in the primary's
+# broadcast view but is not yet (fully) visible on this host's shared
+# storage: FileNotFoundError for a missing step dir, plus the
+# ValueError/OSError orbax raises on a half-visible dir whose metadata
+# has not finished replicating (ADVICE r3: catching only
+# FileNotFoundError failed the eval job on first hit of those). The
+# retry is bounded, so a genuinely corrupt checkpoint still raises
+# after _RESTORE_ATTEMPTS. FileNotFoundError ⊂ OSError; listed for the
+# reader.
+_RESTORE_RETRY_EXCEPTIONS = (FileNotFoundError, ValueError, OSError)
+_RESTORE_ATTEMPTS = 5
+
+
+def _restore_with_retry(checkpoint_manager, template, step: int,
+                        multi_host: bool, sleep_fn=time.sleep):
+  """Restores `step`, re-listing with bounded backoff on a follower.
+
+  Multi-host continuous eval: the pending-step list is the primary's
+  broadcast view — the sync exists precisely because per-host directory
+  listings lag on shared storage, so a follower may be told about a
+  step its own filesystem view doesn't show yet. Single-host (or final
+  attempt), every error propagates: there is no other writer whose
+  lagging visibility a wait could fix.
+  """
+  for attempt in range(_RESTORE_ATTEMPTS):
+    try:
+      return checkpoint_manager.restore(template, step=step)
+    except _RESTORE_RETRY_EXCEPTIONS:
+      if not multi_host or attempt == _RESTORE_ATTEMPTS - 1:
+        raise
+      _log.info(
+          "continuous eval: step %d not (fully) visible yet on this "
+          "host (attempt %d); re-listing after backoff", step,
+          attempt + 1)
+      sleep_fn(min(2.0 ** attempt, 10.0))
+      checkpoint_manager.reload()
+  raise AssertionError("unreachable: loop returns or raises")
+
+
 @configurable
 def continuous_eval_model(
     model,
@@ -564,25 +603,8 @@ def continuous_eval_model(
       pending, timed_out = agree_on_pending(pending, timed_out)
       for step in pending:  # every checkpoint, oldest first — no holes
         last_new_checkpoint = time.monotonic()
-        # Multi-host: the step list is the primary's broadcast view —
-        # the sync exists precisely because per-host directory listings
-        # lag on shared storage, so a follower may be told about a step
-        # its own filesystem view doesn't show yet. Re-list and retry
-        # with bounded backoff before failing the eval job.
-        state = None
-        for attempt in range(5):
-          try:
-            state = checkpoint_manager.restore(template, step=step)
-            break
-          except FileNotFoundError:
-            if not multi_host or attempt == 4:
-              raise
-            _log.info(
-                "continuous eval: step %d not visible yet on this host "
-                "(attempt %d); re-listing after backoff", step,
-                attempt + 1)
-            time.sleep(min(2.0 ** attempt, 10.0))
-            checkpoint_manager.reload()
+        state = _restore_with_retry(checkpoint_manager, template, step,
+                                    multi_host)
         metrics, images = _evaluate(trainer, model, input_generator_eval,
                                     state, eval_steps, prefetch_depth)
         results[step] = metrics
