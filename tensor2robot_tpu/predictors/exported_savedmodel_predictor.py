@@ -57,6 +57,30 @@ class ExportedSavedModelPredictor(AbstractPredictor):
                           for k, v in flat.items()})
     return {k: v.numpy() for k, v in outputs.items()}
 
+  def predict_examples(self, serialized) -> Dict[str, np.ndarray]:
+    """Serves a batch of SERIALIZED tf.Example records via the export's
+    `tf_example` signature — the robot wire path (reference
+    §ExportedSavedModelPredictor served the same signature): parsing,
+    decode_raw of uint8 image bytes, and the model run all happen
+    inside the loaded SavedModel, so the caller ships exactly what the
+    data-collection fleet logs.
+
+    Args:
+      serialized: sequence of `tf.train.Example.SerializeToString()`
+        byte strings.
+    """
+    import tensorflow as tf
+    self.assert_is_loaded()
+    if "tf_example" not in self._loaded.signatures:
+      raise ValueError(
+          "This SavedModel was exported without the tf_example "
+          "signature (SavedModelExportGenerator("
+          "with_tf_example_signature=False)); use predict() with "
+          "numpy feeds instead.")
+    fn = self._loaded.signatures["tf_example"]
+    outputs = fn(tf.constant(list(serialized), dtype=tf.string))
+    return {k: v.numpy() for k, v in outputs.items()}
+
   def get_feature_specification(self) -> ts.TensorSpecStruct:
     self.assert_is_loaded()
     return self._feature_spec

@@ -285,6 +285,82 @@ print("UINT8-SAVEDMODEL-OK")
     assert "UINT8-SAVEDMODEL-OK" in result.stdout, (
         f"stdout={result.stdout}\nstderr={result.stderr[-3000:]}")
 
+  def test_raw_wire_uint8_end_to_end_through_predictor_subprocess(
+      self, tmp_path):
+    """VERDICT r3 #7 — the full robot wire loop for the raw-uint8
+    format: export a wire_format='raw', uint8_images=True model, load
+    it through ExportedSavedModelPredictor (poll/restore path, not a
+    bare tf.saved_model.load), assert the serving signature takes
+    uint8 end-to-end, and drive BOTH entry points: numpy uint8 batches
+    (predict) and serialized uint8 tf.Example records exactly as the
+    training pipeline writes them (predict_examples)."""
+    script = f"""
+import os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax, numpy as np
+from tensor2robot_tpu.export.savedmodel_export_generator import (
+    SavedModelExportGenerator)
+from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+    ExportedSavedModelPredictor)
+from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+model = QTOptGraspingModel(image_size=32, uint8_images=True,
+                           wire_format="raw")
+variables = jax.device_get(
+    model.init_variables(jax.random.key(0), batch_size=2))
+export_root = {str(tmp_path / "sm_raw")!r}
+gen = SavedModelExportGenerator(export_root=export_root,
+                                platforms=("cpu",))
+gen.set_specification_from_model(model)
+gen.export(variables)
+
+predictor = ExportedSavedModelPredictor(export_root)
+assert predictor.restore(timeout_s=5.0)
+# The serving contract is uint8 end-to-end: the packaged spec AND the
+# loaded signature both take uint8 images.
+spec = predictor.get_feature_specification()
+assert np.dtype(spec["image"].dtype) == np.uint8, spec["image"].dtype
+import tensorflow as tf
+sig_inputs = {{
+    i.name.split(":")[0]: i.dtype
+    for i in predictor._fn.inputs if i.dtype != tf.resource}}
+assert sig_inputs.get("image") == tf.uint8, sig_inputs
+
+rng = np.random.default_rng(0)
+images = rng.integers(0, 256, (2, 32, 32, 3)).astype(np.uint8)
+actions = rng.standard_normal((2, 4)).astype(np.float32)
+expected = model.predict_fn(variables, ts.TensorSpecStruct(
+    {{"image": images, "action": actions}}))
+
+# Path 1: numpy uint8 feed through serving_default.
+out_np = predictor.predict({{"image": images, "action": actions}})
+np.testing.assert_allclose(
+    out_np["q_predicted"], np.asarray(expected["q_predicted"]),
+    atol=1e-3)  # bf16 compute: jax2tf CPU vs jax differ O(1e-4)
+
+# Path 2: serialized uint8 tf.Example records — the same encoding the
+# raw-wire training pipeline writes (image tensor's own bytes).
+from tensor2robot_tpu.data.example_proto import encode_example
+records = [encode_example({{
+    "image": [images[i].tobytes()],
+    "action": actions[i],
+}}) for i in range(2)]
+out_ex = predictor.predict_examples(records)
+np.testing.assert_allclose(
+    out_ex["q_predicted"], np.asarray(expected["q_predicted"]),
+    atol=1e-3)  # bf16 compute: jax2tf CPU vs jax differ O(1e-4)
+predictor.close()
+print("RAW-UINT8-PREDICTOR-OK")
+"""
+    result = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=420)
+    assert "RAW-UINT8-PREDICTOR-OK" in result.stdout, (
+        f"stdout={result.stdout}\nstderr={result.stderr[-3000:]}")
+
 
 class TestFetchVariablesToHost:
 
