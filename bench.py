@@ -351,12 +351,20 @@ def _step_budget(anchor_ms_spread, reps=5):
   key = jax.random.key(0)
 
   class Stem(nn.Module):
+    # pool_kind "flax" = nn.max_pool (reduce-window; SelectAndScatter
+    # backward) — the production default; "reshape" = ops/pool.py
+    # formulation, measured here as a candidate swap.
+    pool_kind: str = "flax"
+
     @nn.compact
     def __call__(self, x):
+      from tensor2robot_tpu.ops.pool import max_pool_reshape
       x = normalize_image(x, dtype)
       x = nn.Conv(64, (6, 6), strides=(4, 4), dtype=dtype, name="stem")(x)
       x = nn.relu(nn.BatchNorm(
           use_running_average=False, dtype=dtype, name="stem_bn")(x))
+      if self.pool_kind == "reshape":
+        return max_pool_reshape(x)
       return nn.max_pool(x, (2, 2), strides=(2, 2))
 
   class PreTower(nn.Module):
@@ -455,6 +463,11 @@ def _step_budget(anchor_ms_spread, reps=5):
   budget = {}
   budget["stem_incl_batch_read"] = _spread(
       piece_ms(Stem(), (x_img,), grad_argnums=(0,)), 3)
+  # Candidate swap measured side by side (ops/pool.py): identical
+  # function, reshape-max backward instead of SelectAndScatter.
+  budget["stem_variant_reshape_pool"] = _spread(
+      piece_ms(Stem(pool_kind="reshape"), (x_img,), grad_argnums=(0,)),
+      3)
   budget["pre_tower_3x_conv3x3_59sq"] = _spread(
       piece_ms(PreTower(), (x_59,), grad_argnums=(0, 1)), 3)
   budget["action_merge_dense"] = _spread(
@@ -498,7 +511,8 @@ def _step_budget(anchor_ms_spread, reps=5):
   budget["optimizer_update"] = _spread(
       [s for s in opt_samples if s > 0] or opt_samples, 3)
 
-  pieces_total = sum(v["median"] for v in budget.values())
+  pieces_total = sum(v["median"] for key, v in budget.items()
+                     if not key.startswith("stem_variant"))
   anchor = anchor_ms_spread["median"]
   budget["sum_of_pieces_ms"] = round(pieces_total, 3)
   budget["measured_full_step_ms"] = anchor_ms_spread

@@ -283,3 +283,65 @@ class TestFoldedS2dStem:
     w = stem_conv.init_folded_stem_weights(jax.random.key(0), 3, 8)
     y = stem_conv.folded_s2d_stem(x, w)
     assert y.shape == (1, 8, 8, 8)  # ceil(30/4) = 8
+
+
+class TestMaxPoolReshape:
+  """ops/pool.py: the reshape formulation of non-overlapping max pool."""
+
+  def test_forward_matches_nn_max_pool(self):
+    import flax.linen as nn
+    from tensor2robot_tpu.ops.pool import max_pool_reshape
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 8, 6, 3)), jnp.float32)
+    got = max_pool_reshape(x)
+    want = nn.max_pool(x, (2, 2), strides=(2, 2))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+  def test_forward_matches_on_relu_ties(self):
+    """Whole-window ties (post-relu zeros) — forward must still agree."""
+    import flax.linen as nn
+    from tensor2robot_tpu.ops.pool import max_pool_reshape
+    rng = np.random.default_rng(1)
+    x = jnp.maximum(
+        jnp.asarray(rng.standard_normal((1, 4, 4, 2)), jnp.float32), 0)
+    np.testing.assert_array_equal(
+        np.asarray(max_pool_reshape(x)),
+        np.asarray(nn.max_pool(x, (2, 2), strides=(2, 2))))
+
+  def test_gradient_is_valid_subgradient(self):
+    """No ties: gradient must equal max_pool's exactly (all mass on the
+    window max). With ties the conventions differ (documented); the
+    tie-free contract is the one that must hold hard."""
+    import flax.linen as nn
+    from tensor2robot_tpu.ops.pool import max_pool_reshape
+    rng = np.random.default_rng(2)
+    # Distinct values => no ties.
+    x = jnp.asarray(
+        rng.permutation(8 * 8 * 2).reshape(1, 8, 8, 2), jnp.float32)
+    g1 = jax.grad(lambda x: jnp.sum(max_pool_reshape(x) ** 2))(x)
+    g2 = jax.grad(lambda x: jnp.sum(
+        nn.max_pool(x, (2, 2), strides=(2, 2)) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2))
+
+  def test_tie_gradient_sums_to_same_mass(self):
+    """On ties, total gradient mass per window must be conserved even
+    though its distribution differs from SelectAndScatter's."""
+    from tensor2robot_tpu.ops.pool import max_pool_reshape
+    x = jnp.zeros((1, 2, 2, 1), jnp.float32)  # one fully-tied window
+    g = jax.grad(lambda x: jnp.sum(max_pool_reshape(x)))(x)
+    assert float(jnp.sum(g)) == 1.0
+
+  def test_bfloat16_window4(self):
+    import flax.linen as nn
+    from tensor2robot_tpu.ops.pool import max_pool_reshape
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 4)), jnp.bfloat16)
+    got = max_pool_reshape(x, window=4)
+    want = nn.max_pool(x, (4, 4), strides=(4, 4))
+    np.testing.assert_array_equal(
+        np.asarray(got, np.float32), np.asarray(want, np.float32))
+
+  def test_ragged_size_rejected(self):
+    from tensor2robot_tpu.ops.pool import max_pool_reshape
+    with pytest.raises(ValueError, match="divisible"):
+      max_pool_reshape(jnp.zeros((1, 7, 8, 1)))
