@@ -583,3 +583,31 @@ class TestCapabilityChecksCLI:
     monkeypatch.setattr(rcc, "_CHECKS", {"b_fine": fine})
     assert rcc.main(["--checks", "all",
                      "--workdir", os.fspath(tmp_path)]) == 0
+
+  def test_seed_offset_plumbing(self, monkeypatch, tmp_path, capsys):
+    """--seed-offset reaches checks that declare it, is flagged as
+    ignored on checks that don't, and lands in the output record."""
+    from tensor2robot_tpu.bin import run_capability_checks as rcc
+
+    seen = {}
+
+    def with_seed(scale, workdir, seed_offset=0):
+      seen["seed_offset"] = seed_offset
+      return {"success_rate": 1.0}
+
+    def without_seed(scale, workdir):
+      return {"success_rate": 1.0}
+
+    monkeypatch.setattr(
+        rcc, "_CHECKS", {"a_seeded": with_seed, "b_plain": without_seed})
+    monkeypatch.setitem(rcc._EXPECT, ("a_seeded", "fast"), 0.5)
+    monkeypatch.setitem(rcc._EXPECT, ("b_plain", "fast"), 0.5)
+    rc = rcc.main(["--checks", "all", "--workdir", os.fspath(tmp_path),
+                   "--seed-offset", "7"])
+    assert rc == 0
+    assert seen["seed_offset"] == 7
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["seed_offset"] == 7
+    assert "seed_offset_ignored" not in lines[0]
+    assert lines[1].get("seed_offset_ignored") is True
