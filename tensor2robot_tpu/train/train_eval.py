@@ -297,6 +297,12 @@ def train_eval_model(
     if input_generator_train is not None and max_train_steps > 0:
       input_generator_train.set_specification_from_model(model, modes.TRAIN)
       host_iter = input_generator_train.create_dataset_fn(modes.TRAIN)()
+      pipeline_stats = getattr(input_generator_train, "pipeline_stats",
+                               None)
+      if pipeline_stats:
+        # Surfaces the native/python auto-calibration decision (record
+        # generators) where an operator reading the run log looks first.
+        _log.info("train input pipeline: %s", pipeline_stats)
       start_step = int(state.step)
       if iterations_per_loop > 1 or gradient_accumulation_steps > 1:
         # Both modes feed (K, batch, ...) stacks; they differ only in K
