@@ -144,6 +144,32 @@ class TestPallasKernelsOnChip:
         np.asarray(dense.apply(variables, x)), atol=5e-3, rtol=5e-3)
 
 
+  def test_max_pool_reshape_on_chip(self):
+    """ops/pool.py reshape formulation: exact forward parity with
+    nn.max_pool and tie-free gradient parity, ON CHIP (the backward
+    lowers through compare/mask vs SelectAndScatter — both must agree
+    numerically where the function is differentiable)."""
+    _require_tpu()
+    import flax.linen as nn
+
+    from tensor2robot_tpu.ops.pool import max_pool_reshape
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((8, 118, 118, 64)), jnp.bfloat16)
+    got = jax.jit(max_pool_reshape)(x)
+    want = jax.jit(lambda x: nn.max_pool(x, (2, 2), strides=(2, 2)))(x)
+    np.testing.assert_array_equal(
+        np.asarray(got, np.float32), np.asarray(want, np.float32))
+    # Tie-free grads (permutation => distinct values) must match.
+    xf = jnp.asarray(
+        rng.permutation(4 * 16 * 16 * 8).reshape(4, 16, 16, 8),
+        jnp.float32)
+    g1 = jax.jit(jax.grad(lambda x: jnp.sum(max_pool_reshape(x))))(xf)
+    g2 = jax.jit(jax.grad(lambda x: jnp.sum(
+        nn.max_pool(x, (2, 2), strides=(2, 2)))))(xf)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+
 class TestFamilySmokesOnChip:
   """Real train steps per model family on the chip — small shapes so
   each compile stays in the tens of seconds."""
