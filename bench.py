@@ -385,13 +385,31 @@ def _step_budget(anchor_ms_spread, reps=5):
       return nn.relu(x + emb[:, None, None, :])
 
   class PostTower(nn.Module):
+    # conv_kind "direct" = nn.Conv strided SAME (production default);
+    # "folded" = ops/strided_conv.py lanes-folded formulation — same
+    # function, measured here as a candidate swap for the strided
+    # backward shapes the r3 ablation flagged.
+    conv_kind: str = "direct"
+
     @nn.compact
     def __call__(self, x):
+      from tensor2robot_tpu.ops.strided_conv import strided3x3_same
       for i, stride in enumerate((2, 2, 2)):
+        if self.conv_kind == "folded":
+          c = x.shape[-1]
+          kernel = self.param(f"post_conv{i}_kernel",
+                              nn.initializers.lecun_normal(),
+                              (3, 3, c, 64))
+          bias = self.param(f"post_conv{i}_bias",
+                            nn.initializers.zeros, (64,))
+          x = strided3x3_same(x, kernel.astype(dtype)) + bias.astype(
+              dtype)
+        else:
+          x = nn.Conv(64, (3, 3), strides=(stride, stride), dtype=dtype,
+                      name=f"post_conv{i}")(x)
         x = nn.relu(nn.BatchNorm(
-            use_running_average=False, dtype=dtype, name=f"post_bn{i}")(
-                nn.Conv(64, (3, 3), strides=(stride, stride), dtype=dtype,
-                        name=f"post_conv{i}")(x)))
+            use_running_average=False, dtype=dtype,
+            name=f"post_bn{i}")(x))
       return x
 
   class HeadLoss(nn.Module):
@@ -474,6 +492,9 @@ def _step_budget(anchor_ms_spread, reps=5):
       piece_ms(ActionMerge(), (x_59, action), grad_argnums=(0, 1)), 3)
   budget["post_tower_3x_strided_conv"] = _spread(
       piece_ms(PostTower(), (x_59,), grad_argnums=(0, 1)), 3)
+  budget["post_tower_variant_folded"] = _spread(
+      piece_ms(PostTower(conv_kind="folded"), (x_59,),
+               grad_argnums=(0, 1)), 3)
   budget["head_pool_fc_loss"] = _spread(
       piece_ms(HeadLoss(), (x_59, target), grad_argnums=(0, 1),
                scalar_output=True), 3)
@@ -512,7 +533,7 @@ def _step_budget(anchor_ms_spread, reps=5):
       [s for s in opt_samples if s > 0] or opt_samples, 3)
 
   pieces_total = sum(v["median"] for key, v in budget.items()
-                     if not key.startswith("stem_variant"))
+                     if "_variant" not in key)
   anchor = anchor_ms_spread["median"]
   budget["sum_of_pieces_ms"] = round(pieces_total, 3)
   budget["measured_full_step_ms"] = anchor_ms_spread

@@ -345,3 +345,67 @@ class TestMaxPoolReshape:
     from tensor2robot_tpu.ops.pool import max_pool_reshape
     with pytest.raises(ValueError, match="divisible"):
       max_pool_reshape(jnp.zeros((1, 7, 8, 1)))
+
+
+class TestFoldedStrided3x3:
+  """ops/strided_conv.py: exact function parity with the strided SAME
+  conv, forward and backward, across odd/even sizes."""
+
+  def _reference(self, x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, (2, 2), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+  @pytest.mark.parametrize("hw", [59, 118, 8, 7, 15, 30])
+  def test_forward_matches_same_conv(self, hw):
+    from tensor2robot_tpu.ops.strided_conv import strided3x3_same
+    rng = np.random.default_rng(hw)
+    x = jnp.asarray(rng.standard_normal((2, hw, hw, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 3, 8, 16)) * 0.1,
+                    jnp.float32)
+    got = strided3x3_same(x, w)
+    want = self._reference(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+  def test_rectangular_input(self):
+    from tensor2robot_tpu.ops.strided_conv import strided3x3_same
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((1, 13, 22, 4)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 3, 4, 4)) * 0.1, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(strided3x3_same(x, w)),
+        np.asarray(self._reference(x, w)), atol=1e-5, rtol=1e-5)
+
+  def test_gradients_match_both_args(self):
+    from tensor2robot_tpu.ops.strided_conv import strided3x3_same
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 15, 15, 4)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 3, 4, 8)) * 0.1, jnp.float32)
+
+    def loss(fn):
+      return lambda x, w: jnp.sum(fn(x, w) ** 2)
+
+    gx1, gw1 = jax.grad(loss(strided3x3_same), argnums=(0, 1))(x, w)
+    gx2, gw2 = jax.grad(loss(self._reference), argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                               atol=1e-4, rtol=1e-4)
+
+  def test_fold_layout(self):
+    """Folded kernel places column taps at (s, q) with 2s+q = col and
+    zeros the structural taps."""
+    from tensor2robot_tpu.ops.strided_conv import fold_strided3x3_weights
+    w = jnp.arange(3 * 3 * 2 * 1, dtype=jnp.float32).reshape(3, 3, 2, 1)
+    wf = np.asarray(fold_strided3x3_weights(w)).reshape(4, 2, 2, 2, 1)
+    np.testing.assert_array_equal(wf[3], 0)         # row 3 zero
+    np.testing.assert_array_equal(wf[0:3, 1, 1], 0)  # col-3 phase zero
+    np.testing.assert_array_equal(wf[0:3, 0, 0], np.asarray(w[:, 0]))
+    np.testing.assert_array_equal(wf[0:3, 0, 1], np.asarray(w[:, 1]))
+    np.testing.assert_array_equal(wf[0:3, 1, 0], np.asarray(w[:, 2]))
+
+  def test_non_3x3_rejected(self):
+    from tensor2robot_tpu.ops.strided_conv import fold_strided3x3_weights
+    with pytest.raises(ValueError, match="3, 3"):
+      fold_strided3x3_weights(jnp.zeros((5, 5, 2, 2)))
