@@ -396,6 +396,7 @@ def _step_budget(anchor_ms_spread, reps=5):
       from tensor2robot_tpu.ops.strided_conv import strided3x3_same
       for i, stride in enumerate((2, 2, 2)):
         if self.conv_kind == "folded":
+          assert stride == 2, "strided3x3_same hardcodes stride 2"
           c = x.shape[-1]
           kernel = self.param(f"post_conv{i}_kernel",
                               nn.initializers.lecun_normal(),
