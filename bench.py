@@ -934,6 +934,28 @@ def main() -> None:
                 "in stem isolation (see ops/stem_conv.py provenance "
                 "notes) but e2e-neutral at this operating point — "
                 "recorded honestly"}
+    # impl="fast" (ops/pool.py reshape pool + ops/strided_conv.py
+    # folded strided convs): same function and checkpoint layout as
+    # parity — these variants answer, end to end, whether the budget's
+    # piece-level candidates buy real step time.
+    v_fast_b32, _, _ = _measure_config(
+        QTOptGraspingModel(impl="fast"), parity_batch, k,
+        warmup=1, measure=2)
+    variants["parity_b32_fast_impl"] = {
+        "steps_per_sec_per_chip": v_fast_b32,
+        "vs_baseline_steps_basis": round(
+            v_fast_b32 / (fork_estimate_img_s / parity_batch), 2),
+        "note": "identical math to parity_b32 (impl='fast': reshape "
+                "max pool + lanes-folded strided convs); compare "
+                "steps_per_sec with parity_b32 to read the win"}
+    v_fast_headline, _, _ = _measure_config(
+        QTOptGraspingModel(uint8_images=True, impl="fast"),
+        headline_batch, k, warmup=1, measure=2)
+    variants["headline_fast_impl_b128_uint8"] = {
+        "steps_per_sec_per_chip": v_fast_headline,
+        "images_per_sec_per_chip": round(
+            v_fast_headline * headline_batch),
+        "note": "headline operating point with impl='fast'"}
   except Exception as e:
     variants["error"] = f"{type(e).__name__}: {e}"
 

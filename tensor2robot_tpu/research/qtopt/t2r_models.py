@@ -30,7 +30,8 @@ from tensor2robot_tpu import modes
 from tensor2robot_tpu.config import configurable
 from tensor2robot_tpu.layers.vision_layers import normalize_image
 from tensor2robot_tpu.models.critic_model import CriticModel
-from tensor2robot_tpu.ops import stem_conv
+from tensor2robot_tpu.ops import stem_conv, strided_conv
+from tensor2robot_tpu.ops.pool import max_pool_reshape
 from tensor2robot_tpu.preprocessors.image_preprocessors import (
     ImagePreprocessor,
 )
@@ -38,6 +39,25 @@ from tensor2robot_tpu.specs import tensorspec_utils as ts
 
 IMAGE_SIZE = 472
 ACTION_SIZE = 4  # cartesian displacement (3) + gripper command (1)
+
+
+class _FoldedStridedConv(nn.Module):
+  """3×3 stride-2 SAME conv via ops/strided_conv.strided3x3_same, with
+  nn.Conv-identical param layout (`kernel` (3,3,C,O) + `bias` (O,)) so
+  parity and fast checkpoints interchange with no conversion."""
+
+  features: int
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x):
+    kernel = self.param(
+        "kernel", nn.initializers.lecun_normal(),
+        (3, 3, x.shape[-1], self.features))
+    bias = self.param("bias", nn.initializers.zeros, (self.features,))
+    y = strided_conv.strided3x3_same(
+        x.astype(self.dtype), kernel.astype(self.dtype))
+    return y + bias.astype(self.dtype)
 
 
 class _GraspingQModule(nn.Module):
@@ -63,6 +83,15 @@ class _GraspingQModule(nn.Module):
   # the lane gain and drops the transpose (stem fwd+grad_w 1269 µs vs
   # 1701 µs parity, 2026-07-31 — ops/stem_conv.py docstring).
   stem_kind: str = "conv"
+  # "parity": flax nn.max_pool + strided nn.Conv lowerings (the
+  # reference-shaped defaults). "fast": the SAME functions via the
+  # TPU-friendlier formulations — ops/pool.max_pool_reshape (no
+  # SelectAndScatter backward) and ops/strided_conv.strided3x3_same
+  # (lanes-folded strided conv) — with IDENTICAL param names/shapes
+  # (post_conv{i}/kernel+bias), so checkpoints interchange freely.
+  # Outputs differ only by float reassociation (tested). Adoption as
+  # default awaits the on-chip step-budget numbers (bench.py).
+  impl: str = "parity"
 
   @nn.compact
   def __call__(self, features, mode: str):
@@ -91,7 +120,10 @@ class _GraspingQModule(nn.Module):
     else:
       raise ValueError(f"Unknown stem_kind {self.stem_kind!r}")
     x = nn.relu(norm("stem_bn")(x))
-    x = nn.max_pool(x, (2, 2), strides=(2, 2))
+    if self.impl == "fast" and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+      x = max_pool_reshape(x)
+    else:
+      x = nn.max_pool(x, (2, 2), strides=(2, 2))
     for i in range(3):
       x = nn.relu(norm(f"pre_bn{i}")(nn.Conv(
           64, (3, 3), dtype=dtype, name=f"pre_conv{i}")(x)))
@@ -111,11 +143,15 @@ class _GraspingQModule(nn.Module):
     embedding = nn.Dense(64, dtype=dtype, name="action_fc2")(embedding)
     x = nn.relu(x + embedding[:, None, None, :])
 
-    # Post-merge tower: 59 -> 29 -> 14 -> 7.
-    for i, stride in enumerate((2, 2, 2)):
-      x = nn.relu(norm(f"post_bn{i}")(nn.Conv(
-          64, (3, 3), strides=(stride, stride), dtype=dtype,
-          name=f"post_conv{i}")(x)))
+    # Post-merge tower: 59 -> 30 -> 15 -> 8 (SAME/2 each).
+    for i in range(3):
+      if self.impl == "fast":
+        conv = _FoldedStridedConv(features=64, dtype=dtype,
+                                  name=f"post_conv{i}")(x)
+      else:
+        conv = nn.Conv(64, (3, 3), strides=(2, 2), dtype=dtype,
+                       name=f"post_conv{i}")(x)
+      x = nn.relu(norm(f"post_bn{i}")(conv))
 
     x = jnp.mean(x, axis=(1, 2))  # global pool → (B, 64)
     x = nn.relu(nn.Dense(64, dtype=dtype, name="fc1")(x))
@@ -139,6 +175,7 @@ class QTOptGraspingModel(CriticModel):
                norm: str = "batch",
                stem: str = "conv",
                wire_format: str = "jpeg",
+               impl: str = "parity",
                **kwargs):
     """state_size > 0 adds a proprioceptive `state` vector feature
     (gripper status etc., reference's non-image state).
@@ -155,12 +192,16 @@ class QTOptGraspingModel(CriticModel):
     network, bounds the pipeline).
 
     norm: "batch" (reference parity) or "group"; stem: "conv" (parity)
-    or "space_to_depth" (MXU-friendly stem lanes) — see
-    _GraspingQModule field docs."""
+    or "space_to_depth" (MXU-friendly stem lanes); impl: "parity" or
+    "fast" (same function + same checkpoint layout via TPU-friendlier
+    pool/strided-conv formulations) — see _GraspingQModule field
+    docs."""
     super().__init__(**kwargs)
     if wire_format not in ("jpeg", "raw"):
       raise ValueError(f"wire_format must be 'jpeg' or 'raw', got "
                        f"{wire_format!r}")
+    if impl not in ("parity", "fast"):
+      raise ValueError(f"impl must be 'parity' or 'fast', got {impl!r}")
     self._image_size = image_size
     self._in_image_size = in_image_size or image_size
     self._action_size = action_size
@@ -170,6 +211,7 @@ class QTOptGraspingModel(CriticModel):
     self._norm = norm
     self._stem = stem
     self._wire_format = wire_format
+    self._impl = impl
 
   def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
     del mode
@@ -207,4 +249,5 @@ class QTOptGraspingModel(CriticModel):
         action_size=self._action_size,
         compute_dtype=self.compute_dtype,
         norm_kind=self._norm,
-        stem_kind=self._stem)
+        stem_kind=self._stem,
+        impl=self._impl)

@@ -457,3 +457,70 @@ class TestResearchConfigs:
       assert isinstance(model, AbstractT2RModel)
     finally:
       cfg_lib.clear_config()
+
+
+class TestFastImpl:
+  """impl='fast' (reshape pool + folded strided convs): same function,
+  same checkpoint layout as impl='parity'."""
+
+  def test_param_trees_identical(self):
+    import jax
+
+    m_parity = QTOptGraspingModel(image_size=64, in_image_size=64)
+    m_fast = QTOptGraspingModel(image_size=64, in_image_size=64,
+                                impl="fast")
+    v1 = m_parity.init_variables(jax.random.key(0), batch_size=2)
+    v2 = m_fast.init_variables(jax.random.key(0), batch_size=2)
+    paths1 = {jax.tree_util.keystr(p): leaf.shape for p, leaf in
+              jax.tree_util.tree_flatten_with_path(v1["params"])[0]}
+    paths2 = {jax.tree_util.keystr(p): leaf.shape for p, leaf in
+              jax.tree_util.tree_flatten_with_path(v2["params"])[0]}
+    assert paths1 == paths2
+
+  def test_outputs_match_with_swapped_checkpoints(self):
+    """A parity-trained param tree served through the fast impl (and
+    vice versa) must produce the same Q values up to reassociation."""
+    import jax
+
+    from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+    m_parity = QTOptGraspingModel(image_size=64, in_image_size=64)
+    m_fast = QTOptGraspingModel(image_size=64, in_image_size=64,
+                                impl="fast")
+    variables = jax.device_get(
+        m_parity.init_variables(jax.random.key(1), batch_size=2))
+    rng = np.random.default_rng(0)
+    feats = ts.TensorSpecStruct({
+        "image": rng.random((4, 64, 64, 3)).astype(np.float32),
+        "action": rng.standard_normal((4, 4)).astype(np.float32)})
+    out_parity = m_parity.predict_fn(variables, feats)
+    out_fast = m_fast.predict_fn(variables, feats)
+    np.testing.assert_allclose(
+        np.asarray(out_parity["q_predicted"]),
+        np.asarray(out_fast["q_predicted"]),
+        atol=5e-2)  # bf16 tower + reassociation
+
+  def test_fast_impl_trains(self):
+    import jax
+
+    from tensor2robot_tpu.train.trainer import Trainer
+
+    model = QTOptGraspingModel(image_size=64, in_image_size=64,
+                               impl="fast",
+                               optimizer_fn=lambda: optax.adam(1e-3))
+    trainer = Trainer(model, seed=0)
+    state = trainer.create_train_state(batch_size=8)
+    rng = np.random.default_rng(2)
+    from tensor2robot_tpu.specs import tensorspec_utils as ts
+    feats = ts.TensorSpecStruct({
+        "image": rng.random((8, 64, 64, 3)).astype(np.float32),
+        "action": rng.standard_normal((8, 4)).astype(np.float32)})
+    labels = ts.TensorSpecStruct(
+        {"target_q": rng.random((8,)).astype(np.float32)})
+    fb, lb = trainer.shard_batch((feats, labels))
+    state, metrics = trainer.train_step(state, fb, lb)
+    assert np.isfinite(float(metrics["loss"]))
+
+  def test_invalid_impl_rejected(self):
+    with pytest.raises(ValueError, match="impl"):
+      QTOptGraspingModel(impl="turbo")
