@@ -37,6 +37,7 @@ class ExportedModelPredictor(AbstractPredictor):
     self._variables = None
     self._feature_spec: Optional[ts.TensorSpecStruct] = None
     self._feature_keys = None
+    self._example_parser = None
 
   # --- loading -------------------------------------------------------------
 
@@ -60,6 +61,7 @@ class ExportedModelPredictor(AbstractPredictor):
     self._variables = jax.tree_util.tree_map(jax.numpy.asarray, variables)
     self._feature_spec = feature_spec
     self._feature_keys = extra["feature_keys"]
+    self._example_parser = None  # rebuilt on demand for the new spec
     self._version = newest
     return True
 
@@ -78,6 +80,26 @@ class ExportedModelPredictor(AbstractPredictor):
     args = [np.asarray(flat[key]) for key in self._feature_keys]
     outputs = self._call(self._variables, *args)
     return {k: np.asarray(v) for k, v in outputs.items()}
+
+  def predict_examples(self, serialized) -> Dict[str, np.ndarray]:
+    """Serves a batch of SERIALIZED tf.Example records — TF-free.
+
+    The SavedModel path parses records inside the loaded graph
+    (`ExportedSavedModelPredictor.predict_examples`); the native
+    artifact carries only the computation, so parsing happens here
+    through the packaged feature spec and the repo's dependency-free
+    tf.Example codec (with the C++ whole-batch fast path when the
+    library is available) — a robot without TF can still consume the
+    exact wire format the data-collection fleet logs (raw uint8 bytes,
+    encoded jpeg/png, dense numerics alike, per the spec's
+    data_format).
+    """
+    from tensor2robot_tpu.data.parser import ExampleParser
+    self.assert_is_loaded()
+    if getattr(self, "_example_parser", None) is None:
+      self._example_parser = ExampleParser(self._feature_spec)
+    features, _ = self._example_parser.parse_batch(list(serialized))
+    return self.predict(features)
 
   def device_fn(self):
     """See AbstractPredictor.device_fn: the deserialized StableHLO call
@@ -103,4 +125,5 @@ class ExportedModelPredictor(AbstractPredictor):
     self._call = None
     self._exported_call = None
     self._variables = None
+    self._example_parser = None
     self._version = -1  # assert_is_loaded fails cleanly after close()

@@ -131,6 +131,54 @@ class TestNativeExportRoundTrip:
     # No newer version: restore keeps serving the current one.
     assert predictor.restore()
 
+  def test_predict_examples_tf_free(self, tmp_path):
+    """The native (StableHLO) predictor consumes serialized tf.Example
+    records with NO TF: parsing runs through the packaged spec and the
+    repo codec. Covers the dense-float wire (MockT2RModel) and the
+    raw-uint8 image wire (the robot format VERDICT r3 #7 closed for
+    the SavedModel path)."""
+    model = MockT2RModel()
+    _, state = _trained_state(model)
+    root = str(tmp_path / "exports")
+    gen = NativeExportGenerator(export_root=root)
+    gen.set_specification_from_model(model)
+    gen.export(jax.device_get(state.variables()))
+    predictor = ExportedModelPredictor(root)
+    assert predictor.restore()
+    from tensor2robot_tpu.data.example_proto import encode_example
+    rng = np.random.default_rng(0)
+    xs = rng.random((3, 3)).astype(np.float32)
+    records = [encode_example({"x": xs[i]}) for i in range(3)]
+    out = predictor.predict_examples(records)
+    np.testing.assert_allclose(
+        out["inference_output"],
+        predictor.predict({"x": xs})["inference_output"], atol=1e-6)
+
+    # Raw-uint8 image wire through the native path.
+    from tensor2robot_tpu.research.qtopt.t2r_models import (
+        QTOptGraspingModel)
+    qmodel = QTOptGraspingModel(image_size=32, in_image_size=32,
+                                uint8_images=True, wire_format="raw")
+    variables = jax.device_get(
+        qmodel.init_variables(jax.random.key(0), batch_size=2))
+    qroot = str(tmp_path / "q_exports")
+    qgen = NativeExportGenerator(export_root=qroot)
+    qgen.set_specification_from_model(qmodel)
+    qgen.export(variables)
+    qpred = ExportedModelPredictor(qroot)
+    assert qpred.restore()
+    spec = qpred.get_feature_specification()
+    assert np.dtype(spec["image"].dtype) == np.uint8
+    images = rng.integers(0, 256, (2, 32, 32, 3)).astype(np.uint8)
+    actions = rng.standard_normal((2, 4)).astype(np.float32)
+    qrecords = [encode_example({
+        "image": [images[i].tobytes()], "action": actions[i]})
+        for i in range(2)]
+    out_records = qpred.predict_examples(qrecords)
+    out_numpy = qpred.predict({"image": images, "action": actions})
+    np.testing.assert_allclose(out_records["q_predicted"],
+                               out_numpy["q_predicted"], atol=1e-6)
+
   def test_predict_validates_spec(self, tmp_path):
     model = MockT2RModel()
     _, state = _trained_state(model)
