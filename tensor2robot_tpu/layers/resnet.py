@@ -19,6 +19,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from tensor2robot_tpu.layers.vision_layers import make_norm, normalize_image
+from tensor2robot_tpu.ops.strided_conv import FoldedStridedConv3x3
 
 # depth -> (block sizes, bottleneck?)
 _CONFIGS = {
@@ -53,6 +54,18 @@ class _Block(nn.Module):
   use_film: bool
   dtype: Any
   norm_kind: str = "batch"
+  # "parity" = nn.Conv strided lowerings; "fast" = the 3×3 stride-2
+  # convs go through ops/strided_conv.FoldedStridedConv3x3 — same
+  # function, same param layout (checkpoints interchange), folded
+  # backward shapes. Stride-1 and 1×1 convs are unaffected.
+  impl: str = "parity"
+
+  def _conv3x3_strided(self, features: int, name: str):
+    if self.impl == "fast" and self.stride == 2:
+      return FoldedStridedConv3x3(features, use_bias=False,
+                                  dtype=self.dtype, name=name)
+    return nn.Conv(features, (3, 3), strides=(self.stride,) * 2,
+                   use_bias=False, dtype=self.dtype, name=name)
 
   @nn.compact
   def __call__(self, x, context, train: bool):
@@ -69,15 +82,13 @@ class _Block(nn.Module):
       y = nn.Conv(self.width, (1, 1), use_bias=False, dtype=self.dtype,
                   name="conv1")(x)
       y = nn.relu(norm("bn1")(y))
-      y = nn.Conv(self.width, (3, 3), strides=(self.stride,) * 2,
-                  use_bias=False, dtype=self.dtype, name="conv2")(y)
+      y = self._conv3x3_strided(self.width, "conv2")(y)
       y = nn.relu(norm("bn2")(y))
       y = nn.Conv(out_width, (1, 1), use_bias=False, dtype=self.dtype,
                   name="conv3")(y)
       y = norm("bn3")(y)
     else:
-      y = nn.Conv(self.width, (3, 3), strides=(self.stride,) * 2,
-                  use_bias=False, dtype=self.dtype, name="conv1")(x)
+      y = self._conv3x3_strided(self.width, "conv1")(x)
       y = nn.relu(norm("bn1")(y))
       y = nn.Conv(out_width, (3, 3), use_bias=False, dtype=self.dtype,
                   name="conv2")(y)
@@ -102,6 +113,7 @@ class ResNet(nn.Module):
   return_spatial: bool = False  # also return the pre-pool feature map
   remat: bool = False  # rematerialize each block on the backward pass
   norm: str = "batch"  # 'batch' (reference) or 'group' (vision_layers.make_norm)
+  impl: str = "parity"  # 'fast' folds the stride-2 3×3 convs (see _Block)
   dtype: Any = jnp.bfloat16
 
   @nn.compact
@@ -137,6 +149,7 @@ class ResNet(nn.Module):
             use_film=self.film,
             dtype=self.dtype,
             norm_kind=self.norm,
+            impl=self.impl,
             name=f"stage{stage}_block{block}")(x, context, train)
 
     features = jnp.mean(x, axis=(1, 2))  # global average pool

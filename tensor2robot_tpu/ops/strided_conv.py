@@ -33,6 +33,9 @@ correctness is pinned CPU-side in tests/test_ops.py either way.
 
 from __future__ import annotations
 
+from typing import Any
+
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
@@ -76,3 +79,25 @@ def strided3x3_same(x: jax.Array, w: jax.Array) -> jax.Array:
       padding="VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
   assert y.shape == (b, out_h, out_w, w.shape[-1]), y.shape
   return y
+
+
+class FoldedStridedConv3x3(nn.Module):
+  """Flax wrapper with nn.Conv-IDENTICAL param layout (`kernel`
+  (3, 3, C, O), optional `bias` (O,)) — parity and folded checkpoints
+  interchange with no conversion. Drop-in for
+  `nn.Conv(features, (3, 3), strides=(2, 2))` (SAME padding)."""
+
+  features: int
+  use_bias: bool = True
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x: jax.Array) -> jax.Array:
+    kernel = self.param(
+        "kernel", nn.initializers.lecun_normal(),
+        (3, 3, x.shape[-1], self.features))
+    y = strided3x3_same(x.astype(self.dtype), kernel.astype(self.dtype))
+    if self.use_bias:
+      bias = self.param("bias", nn.initializers.zeros, (self.features,))
+      y = y + bias.astype(self.dtype)
+    return y

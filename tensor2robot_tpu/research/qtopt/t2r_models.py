@@ -41,25 +41,6 @@ IMAGE_SIZE = 472
 ACTION_SIZE = 4  # cartesian displacement (3) + gripper command (1)
 
 
-class _FoldedStridedConv(nn.Module):
-  """3×3 stride-2 SAME conv via ops/strided_conv.strided3x3_same, with
-  nn.Conv-identical param layout (`kernel` (3,3,C,O) + `bias` (O,)) so
-  parity and fast checkpoints interchange with no conversion."""
-
-  features: int
-  dtype: Any = jnp.bfloat16
-
-  @nn.compact
-  def __call__(self, x):
-    kernel = self.param(
-        "kernel", nn.initializers.lecun_normal(),
-        (3, 3, x.shape[-1], self.features))
-    bias = self.param("bias", nn.initializers.zeros, (self.features,))
-    y = strided_conv.strided3x3_same(
-        x.astype(self.dtype), kernel.astype(self.dtype))
-    return y + bias.astype(self.dtype)
-
-
 class _GraspingQModule(nn.Module):
   """The legacy grasping net as one Flax module."""
 
@@ -146,8 +127,8 @@ class _GraspingQModule(nn.Module):
     # Post-merge tower: 59 -> 30 -> 15 -> 8 (SAME/2 each).
     for i in range(3):
       if self.impl == "fast":
-        conv = _FoldedStridedConv(features=64, dtype=dtype,
-                                  name=f"post_conv{i}")(x)
+        conv = strided_conv.FoldedStridedConv3x3(
+            features=64, dtype=dtype, name=f"post_conv{i}")(x)
       else:
         conv = nn.Conv(64, (3, 3), strides=(2, 2), dtype=dtype,
                        name=f"post_conv{i}")(x)

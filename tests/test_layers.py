@@ -329,3 +329,28 @@ class TestUint8WireFormat:
       np.testing.assert_allclose(
           np.asarray(out_u8, np.float32), np.asarray(out_f32, np.float32),
           atol=1e-2)
+
+
+class TestResNetFastImpl:
+  """impl='fast' ResNet: identical function + param layout, folded
+  stride-2 convs (ops/strided_conv)."""
+
+  @pytest.mark.parametrize("depth", [18, 50])
+  def test_param_tree_and_outputs_match(self, depth):
+    from tensor2robot_tpu.layers.resnet import ResNet
+    rng = np.random.default_rng(depth)
+    x = jnp.asarray(rng.random((2, 64, 64, 3)), jnp.float32)
+    m1 = ResNet(depth=depth, impl="parity", dtype=jnp.float32)
+    m2 = ResNet(depth=depth, impl="fast", dtype=jnp.float32)
+    v1 = m1.init(jax.random.key(0), x)
+    v2 = m2.init(jax.random.key(0), x)
+    p1 = {jax.tree_util.keystr(p): l.shape for p, l in
+          jax.tree_util.tree_flatten_with_path(v1["params"])[0]}
+    p2 = {jax.tree_util.keystr(p): l.shape for p, l in
+          jax.tree_util.tree_flatten_with_path(v2["params"])[0]}
+    assert p1 == p2
+    # Same params (from m1's init) through both impls: same features.
+    out1 = m1.apply(v1, x)
+    out2 = m2.apply(v1, x)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=1e-4, rtol=1e-4)
