@@ -40,17 +40,22 @@ from __future__ import annotations
 import json
 import os
 import statistics
+import subprocess
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-DETAIL_FILE = "BENCH_DETAIL_r04.json"
-ROUND = 4
+ROUND = 5
+DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
 MEASURE_LOOPS = 3
+# The headline operating point's batch; used by BOTH the measurement in
+# main() and the metric label in _METRIC_NAME so they cannot diverge.
+HEADLINE_BATCH = 128
 # Steps fused per dispatch via Trainer.train_steps (lax.scan) — the same
 # in-device loop TPUEstimator ran under TPUConfig(iterations_per_loop).
 ITERATIONS_PER_LOOP = 60
@@ -900,7 +905,7 @@ def main() -> None:
     step_budget = {"error": f"{type(e).__name__}: {e}"}
 
   # --- headline operating point (stated): batch 128, uint8 wire ------
-  headline_batch = 128
+  headline_batch = HEADLINE_BATCH
   headline_model = QTOptGraspingModel(uint8_images=True)
   headline_sps, headline_flops, _ = _measure_config(
       headline_model, headline_batch, k)
@@ -1028,8 +1033,7 @@ def main() -> None:
     json.dump(detail, f, indent=2)
 
   print(json.dumps({
-      "metric": "QTOptGraspingModel train images/sec/chip "
-                f"(batch {headline_batch}, uint8 wire, k={k})",
+      "metric": _METRIC_NAME,
       "value": round(headline_img_s),
       "unit": "images/sec/chip",
       "vs_baseline": vs_baseline,
@@ -1045,5 +1049,127 @@ def main() -> None:
   }))
 
 
+# --- driver-contract resilience (VERDICT r4 #1) --------------------------
+# The axon pool exhibits TWO failure modes when no chip is free: an
+# immediate UNAVAILABLE error from backend init, and a silent indefinite
+# hang on the claim. Either one, hit in-process, breaks the ONE-JSON-LINE
+# stdout contract (round 4's driver run: rc=1, parsed=null, raw
+# traceback). So the default entry point is an ORCHESTRATOR that never
+# touches the backend itself: it claims the chip in a bounded-timeout
+# subprocess probe (retried — a successful probe exits immediately,
+# returning the chip to the pool for the real run), then runs the
+# measuring entry in a second bounded subprocess, and converts every
+# failure — probe exhaustion, bench crash, bench hang, garbled output —
+# into ONE structured, parseable JSON line on stdout with rc 0.
+
+_METRIC_NAME = ("QTOptGraspingModel train images/sec/chip "
+                f"(batch {HEADLINE_BATCH}, uint8 wire, "
+                f"k={ITERATIONS_PER_LOOP})")
+
+_PROBE_SNIPPET = "import jax; print(jax.devices()[0].device_kind)"
+
+
+def _emit_error_line(error: str, **extra) -> None:
+  """Failure-path stdout contract: one compact JSON line, never a
+  traceback; value/vs_baseline explicitly null so the driver records a
+  structured outage instead of an unparseable crash."""
+  line = {
+      "metric": _METRIC_NAME,
+      "value": None,
+      "unit": "images/sec/chip",
+      "vs_baseline": None,
+      "error": error,
+  }
+  line.update(extra)
+  print(json.dumps(line))
+
+
+def _probe_backend(timeout_s: float, attempts: int, sleep_s: float):
+  """Claim the TPU in a killable subprocess; (device_kind|None, outcomes).
+
+  Each attempt records "ok", "unavailable_error" (backend init raised),
+  or "hang_timeout" (the silent no-free-chip claim block, killed at the
+  bound). The probe snippet is env-overridable so the failure paths are
+  testable on a box with no chip at all.
+  """
+  snippet = os.environ.get("T2R_BENCH_PROBE_SNIPPET", _PROBE_SNIPPET)
+  outcomes = []
+  for attempt in range(attempts):
+    try:
+      res = subprocess.run(
+          [sys.executable, "-c", snippet],
+          capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+      outcomes.append("hang_timeout")
+    else:
+      if res.returncode == 0 and res.stdout.strip():
+        outcomes.append("ok")
+        return res.stdout.strip().splitlines()[-1], outcomes
+      outcomes.append("unavailable_error")
+    if attempt + 1 < attempts:
+      time.sleep(sleep_s)
+  return None, outcomes
+
+
+def _extract_json_line(text: str):
+  """Last stdout line that parses as a JSON object with the contract
+  keys; compile logs or stray prints around it are tolerated."""
+  for line in reversed(text.strip().splitlines()):
+    line = line.strip()
+    if not line.startswith("{"):
+      continue
+    try:
+      obj = json.loads(line)
+    except ValueError:
+      continue
+    if isinstance(obj, dict) and "metric" in obj and "value" in obj:
+      return line
+  return None
+
+
+def _run_inner(timeout_s: float) -> None:
+  """Run main() in a bounded subprocess and forward its contract line."""
+  snippet = os.environ.get("T2R_BENCH_INNER_SNIPPET")
+  if snippet is not None:
+    cmd = [sys.executable, "-c", snippet]
+  else:
+    cmd = [sys.executable, os.path.abspath(__file__)]
+  env = dict(os.environ, T2R_BENCH_INNER="1")
+  try:
+    res = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=timeout_s, env=env)
+  except subprocess.TimeoutExpired:
+    _emit_error_line("bench_timeout", timeout_s=timeout_s)
+    return
+  if res.returncode != 0:
+    tail = " | ".join(res.stderr.strip().splitlines()[-3:])[-400:]
+    _emit_error_line("bench_failed", returncode=res.returncode,
+                     stderr_tail=tail)
+    return
+  line = _extract_json_line(res.stdout)
+  if line is None:
+    _emit_error_line("bench_output_unparseable")
+    return
+  print(line)
+
+
+def _orchestrate() -> None:
+  probe_timeout = float(os.environ.get("T2R_BENCH_PROBE_TIMEOUT", "240"))
+  attempts = int(os.environ.get("T2R_BENCH_PROBE_ATTEMPTS", "3"))
+  sleep_s = float(os.environ.get("T2R_BENCH_PROBE_SLEEP", "30"))
+  inner_timeout = float(
+      os.environ.get("T2R_BENCH_INNER_TIMEOUT") or 45 * 60)
+  kind, outcomes = _probe_backend(probe_timeout, attempts, sleep_s)
+  if kind is None:
+    _emit_error_line("tpu_pool_unavailable",
+                     probe_attempts=outcomes,
+                     probe_timeout_s=probe_timeout)
+    return
+  _run_inner(inner_timeout)
+
+
 if __name__ == "__main__":
-  main()
+  if os.environ.get("T2R_BENCH_INNER") == "1":
+    main()
+  else:
+    _orchestrate()
