@@ -44,7 +44,7 @@ def _apply_native_mode(
   "auto" peels one batch of records off the stream, times parse_batch
   both ways on it (interleaved — parser.calibrate_native), pins the
   winner, and chains the peeled records back so nothing is dropped or
-  reordered. The one-batch cost (4 parses) is noise next to the jit
+  reordered. The one-batch cost (6 parses) is noise next to the jit
   compile every training run pays; the payoff is that the pipeline
   never runs a path that measures slower on the host it actually
   landed on (VERDICT r3 Weak #1: the native/python ratio is

@@ -517,13 +517,16 @@ def _restore_with_retry(checkpoint_manager, template, step: int,
   for attempt in range(_RESTORE_ATTEMPTS):
     try:
       return checkpoint_manager.restore(template, step=step)
-    except _RESTORE_RETRY_EXCEPTIONS:
+    except _RESTORE_RETRY_EXCEPTIONS as e:
       if not multi_host or attempt == _RESTORE_ATTEMPTS - 1:
         raise
+      # repr(e) in the log (ADVICE r4): a PERMANENT error misclassified
+      # as lag (wrong template structure/dtype) must be diagnosable from
+      # the first attempt's line, not after 5 backoffs re-raise it.
       _log.info(
           "continuous eval: step %d not (fully) visible yet on this "
-          "host (attempt %d); re-listing after backoff", step,
-          attempt + 1)
+          "host (attempt %d, %r); re-listing after backoff", step,
+          attempt + 1, e)
       sleep_fn(min(2.0 ** attempt, 10.0))
       checkpoint_manager.reload()
   raise AssertionError("unreachable: loop returns or raises")

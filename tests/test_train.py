@@ -355,6 +355,44 @@ class TestCheckpoints:
     restored, _ = trainer2.train_step(restored, features, labels)
     assert int(restored.step) == 4
 
+  def test_installed_orbax_writes_default_item_layout(self, tmp_path):
+    """restore()'s visibility probe assumes orbax finalizes a step as
+    `<step>/default` (single-item layout). If an orbax upgrade changes
+    the convention this must fail HERE, at test time — not as a
+    spurious FileNotFoundError at restore time in production
+    (ADVICE r4)."""
+    model = MockT2RModel()
+    trainer = Trainer(model)
+    state = trainer.create_train_state()
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    manager.save(0, state)
+    manager.wait()
+    assert os.path.isdir(str(tmp_path / "ckpt" / "0" / "default"))
+    manager.close()
+
+  def test_restore_probe_layout_detection(self, tmp_path):
+    """The probe is gated on the learned layout convention: unknown →
+    armed (pinned-orbax behavior); a detected non-'default' layout →
+    disarmed, delegate to orbax (ADVICE r4)."""
+    import shutil
+    model = MockT2RModel()
+    trainer = Trainer(model)
+    state = trainer.create_train_state()
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    manager.save(0, state)
+    manager.wait()
+    # Only the probed step itself exists → nothing to learn from yet.
+    assert manager._expects_default_layout(exclude_step=0) is None
+    # Another finalized step to learn from → convention confirmed.
+    assert manager._expects_default_layout(exclude_step=99) is True
+    manager.close()
+    # A hypothetical orbax with a different item layout → disarmed.
+    shutil.move(str(tmp_path / "ckpt" / "0" / "default"),
+                str(tmp_path / "ckpt" / "0" / "state"))
+    manager2 = CheckpointManager(str(tmp_path / "ckpt"))
+    assert manager2._expects_default_layout(exclude_step=99) is False
+    manager2.close()
+
   def test_save_interval_and_gc(self, tmp_path):
     manager = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2,
                                 save_interval_steps=10)
