@@ -669,6 +669,23 @@ class TestRestoreWithRetry:
                           sleep_fn=lambda s: None)
     assert mgr.restore_calls == 1
 
+  def test_retry_log_carries_exception_repr(self, caplog):
+    """ADVICE r4: a permanent error misclassified as lag (wrong
+    template dtype → ValueError) must be diagnosable from the FIRST
+    attempt's log line, not after 5 silent backoffs re-raise it."""
+    import logging
+    from tensor2robot_tpu.train.train_eval import _restore_with_retry
+    mgr = self._FlakyManager(failures=1, exc_type=ValueError)
+    with caplog.at_level(logging.INFO,
+                         logger="tensor2robot_tpu.train.train_eval"):
+      _restore_with_retry(mgr, "tmpl", 3, multi_host=True,
+                          sleep_fn=lambda s: None)
+    retry_lines = [r.getMessage() for r in caplog.records
+                   if "not (fully) visible" in r.getMessage()]
+    assert retry_lines, "no retry log line recorded"
+    assert "ValueError" in retry_lines[0]
+    assert "not visible yet" in retry_lines[0]  # the message text too
+
   def test_real_manager_first_restore_races_checkpoint_write(
       self, tmp_path):
     """End-to-end against REAL orbax — the exact follower situation:
