@@ -1127,30 +1127,52 @@ def _extract_json_line(text: str):
   return None
 
 
-def _run_inner(timeout_s: float) -> None:
-  """Run main() in a bounded subprocess and forward its contract line."""
+def _run_inner(timeout_s: float, attempts: int = 2) -> None:
+  """Run main() in a bounded subprocess and forward its contract line.
+
+  CRASH-ONLY retry (one extra attempt after a short sleep): the probe
+  succeeded moments earlier, so a crash is either deterministic (the
+  retry fails identically; the error line carries BOTH attempts'
+  diagnostics) or a transient pool flap (the sleep+retry rescues the
+  round's only measurement). A timeout is never retried — the known
+  hang mode blocks indefinitely, so a second attempt would only double
+  the driver's wait for its contract line — and unparseable output is
+  never retried (a formatting bug is deterministic; re-running a
+  completed benchmark cannot fix it).
+  """
   snippet = os.environ.get("T2R_BENCH_INNER_SNIPPET")
   if snippet is not None:
     cmd = [sys.executable, "-c", snippet]
   else:
     cmd = [sys.executable, os.path.abspath(__file__)]
   env = dict(os.environ, T2R_BENCH_INNER="1")
-  try:
-    res = subprocess.run(cmd, capture_output=True, text=True,
-                         timeout=timeout_s, env=env)
-  except subprocess.TimeoutExpired:
-    _emit_error_line("bench_timeout", timeout_s=timeout_s)
+  retry_sleep_s = float(os.environ.get("T2R_BENCH_RETRY_SLEEP") or 30)
+  crashes = []
+
+  def _extra():
+    return {"prior_crashes": crashes} if crashes else {}
+
+  for attempt in range(max(1, attempts)):
+    try:
+      res = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+      _emit_error_line("bench_timeout", timeout_s=timeout_s, **_extra())
+      return
+    if res.returncode != 0:
+      tail = " | ".join(res.stderr.strip().splitlines()[-3:])[-400:]
+      crashes.append({"returncode": res.returncode,
+                      "stderr_tail": tail})
+      if attempt + 1 < max(1, attempts):
+        time.sleep(retry_sleep_s)
+      continue
+    line = _extract_json_line(res.stdout)
+    if line is None:
+      _emit_error_line("bench_output_unparseable", **_extra())
+      return
+    print(line)
     return
-  if res.returncode != 0:
-    tail = " | ".join(res.stderr.strip().splitlines()[-3:])[-400:]
-    _emit_error_line("bench_failed", returncode=res.returncode,
-                     stderr_tail=tail)
-    return
-  line = _extract_json_line(res.stdout)
-  if line is None:
-    _emit_error_line("bench_output_unparseable")
-    return
-  print(line)
+  _emit_error_line("bench_failed", attempts=crashes)
 
 
 def _orchestrate() -> None:

@@ -134,17 +134,41 @@ class TestOrchestratorOutage:
     obj = self._parse_single_line(res)
     assert obj == json.loads(inner_line)
 
-  def test_inner_crash_becomes_error_line(self):
+  def test_inner_crash_is_retried_then_reported_with_both_attempts(self):
     res = _run_bench_cli({
         "T2R_BENCH_PROBE_SNIPPET": "print('FakeTPU v5')",
         "T2R_BENCH_INNER_SNIPPET": (
             "import sys; sys.stderr.write('boom-reason\\n'); "
             "sys.exit(3)"),
+        "T2R_BENCH_RETRY_SLEEP": "0",
     })
     obj = self._parse_single_line(res)
     assert obj["error"] == "bench_failed"
-    assert obj["returncode"] == 3
-    assert "boom-reason" in obj["stderr_tail"]
+    # Crash-only retry: both attempts' diagnostics preserved.
+    assert len(obj["attempts"]) == 2
+    for crash in obj["attempts"]:
+      assert crash["returncode"] == 3
+      assert "boom-reason" in crash["stderr_tail"]
+
+  def test_transient_inner_failure_is_retried_once(self, tmp_path):
+    """A mid-run pool flap (probe ok, inner dies) must not forfeit the
+    round's measurement: the inner gets exactly one retry."""
+    marker = tmp_path / "first_attempt_done"
+    inner_line = json.dumps({
+        "metric": "fake", "value": 7, "unit": "x", "vs_baseline": 1.0})
+    snippet = (
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "  open(m, 'w').close(); sys.exit(1)\n"
+        f"print({inner_line!r})\n")
+    res = _run_bench_cli({
+        "T2R_BENCH_PROBE_SNIPPET": "print('FakeTPU v5')",
+        "T2R_BENCH_INNER_SNIPPET": snippet,
+        "T2R_BENCH_RETRY_SLEEP": "0",
+    })
+    obj = self._parse_single_line(res)
+    assert obj == json.loads(inner_line)
 
   def test_inner_hang_becomes_timeout_line(self):
     res = _run_bench_cli({
