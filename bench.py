@@ -1176,9 +1176,14 @@ def _run_inner(timeout_s: float, attempts: int = 2) -> None:
 
 
 def _orchestrate() -> None:
-  probe_timeout = float(os.environ.get("T2R_BENCH_PROBE_TIMEOUT", "240"))
-  attempts = int(os.environ.get("T2R_BENCH_PROBE_ATTEMPTS", "3"))
-  sleep_s = float(os.environ.get("T2R_BENCH_PROBE_SLEEP", "30"))
+  # Probe defaults bound the WORST-case (full outage) time to the error
+  # line at ~6.5 min (2 x 180s + 20s): a healthy claim completes in
+  # well under 180s, and an unknown driver-side timeout must see the
+  # contract line, not a killed process. Longer chip-hunting loops
+  # belong outside (they can re-run bench.py, which is idempotent).
+  probe_timeout = float(os.environ.get("T2R_BENCH_PROBE_TIMEOUT", "180"))
+  attempts = int(os.environ.get("T2R_BENCH_PROBE_ATTEMPTS", "2"))
+  sleep_s = float(os.environ.get("T2R_BENCH_PROBE_SLEEP", "20"))
   inner_timeout = float(
       os.environ.get("T2R_BENCH_INNER_TIMEOUT") or 45 * 60)
   kind, outcomes = _probe_backend(probe_timeout, attempts, sleep_s)
