@@ -43,6 +43,7 @@ import statistics
 import subprocess
 import sys
 import time
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -1088,11 +1089,16 @@ def _probe_backend(timeout_s: float, attempts: int, sleep_s: float):
   """Claim the TPU in a killable subprocess; (device_kind|None, outcomes).
 
   Each attempt records "ok", "unavailable_error" (backend init raised),
-  or "hang_timeout" (the silent no-free-chip claim block, killed at the
-  bound). The probe snippet is env-overridable so the failure paths are
-  testable on a box with no chip at all.
+  "hang_timeout" (the silent no-free-chip claim block, killed at the
+  bound), or "cpu_fallback" (ADVICE r5: on a host with the axon plugin
+  var unset, jax.devices() silently yields the CPU backend — a probe
+  that accepted it would publish CPU numbers as the images/sec/chip
+  headline). A CPU claim is rejected unless T2R_BENCH_ALLOW_CPU=1
+  explicitly opts in. The probe snippet is env-overridable so the
+  failure paths are testable on a box with no chip at all.
   """
   snippet = os.environ.get("T2R_BENCH_PROBE_SNIPPET", _PROBE_SNIPPET)
+  allow_cpu = os.environ.get("T2R_BENCH_ALLOW_CPU") == "1"
   outcomes = []
   for attempt in range(attempts):
     try:
@@ -1103,8 +1109,15 @@ def _probe_backend(timeout_s: float, attempts: int, sleep_s: float):
       outcomes.append("hang_timeout")
     else:
       if res.returncode == 0 and res.stdout.strip():
+        kind = res.stdout.strip().splitlines()[-1]
+        if kind.strip().lower() == "cpu" and not allow_cpu:
+          # Deterministic (the plugin env var is unset, not the pool
+          # flapping): retrying cannot change the answer, so don't
+          # burn attempts*sleep before the error line.
+          outcomes.append("cpu_fallback")
+          return None, outcomes
         outcomes.append("ok")
-        return res.stdout.strip().splitlines()[-1], outcomes
+        return kind, outcomes
       outcomes.append("unavailable_error")
     if attempt + 1 < attempts:
       time.sleep(sleep_s)
@@ -1127,7 +1140,8 @@ def _extract_json_line(text: str):
   return None
 
 
-def _run_inner(timeout_s: float, attempts: int = 2) -> None:
+def _run_inner(timeout_s: float, attempts: int = 2,
+               probed_device_kind: Optional[str] = None) -> None:
   """Run main() in a bounded subprocess and forward its contract line.
 
   CRASH-ONLY retry (one extra attempt after a short sleep): the probe
@@ -1139,6 +1153,17 @@ def _run_inner(timeout_s: float, attempts: int = 2) -> None:
   the driver's wait for its contract line — and unparseable output is
   never retried (a formatting bug is deterministic; re-running a
   completed benchmark cannot fix it).
+
+  `timeout_s` is a SHARED TOTAL budget across every attempt AND the
+  inter-attempt sleep (ADVICE r5: per-attempt budgets made the worst
+  case ~2x T2R_BENCH_INNER_TIMEOUT + sleep, undocumented): each attempt
+  gets only the time remaining, and a retry whose budget the sleep
+  exhausted is abandoned — so the contract line always appears within
+  ~T2R_BENCH_INNER_TIMEOUT of the probe succeeding.
+
+  The forwarded contract line (success or error) carries
+  `probed_device_kind` so a driver can cross-check what chip the
+  orchestrator claimed against what the inner run measured on.
   """
   snippet = os.environ.get("T2R_BENCH_INNER_SNIPPET")
   if snippet is not None:
@@ -1147,15 +1172,22 @@ def _run_inner(timeout_s: float, attempts: int = 2) -> None:
     cmd = [sys.executable, os.path.abspath(__file__)]
   env = dict(os.environ, T2R_BENCH_INNER="1")
   retry_sleep_s = float(os.environ.get("T2R_BENCH_RETRY_SLEEP") or 30)
+  deadline = time.monotonic() + timeout_s
   crashes = []
 
   def _extra():
-    return {"prior_crashes": crashes} if crashes else {}
+    extra = {"crashes": crashes} if crashes else {}
+    if probed_device_kind is not None:
+      extra["probed_device_kind"] = probed_device_kind
+    return extra
 
   for attempt in range(max(1, attempts)):
+    remaining = deadline - time.monotonic()
+    if remaining <= 0:
+      break
     try:
       res = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout_s, env=env)
+                           timeout=remaining, env=env)
     except subprocess.TimeoutExpired:
       _emit_error_line("bench_timeout", timeout_s=timeout_s, **_extra())
       return
@@ -1164,15 +1196,23 @@ def _run_inner(timeout_s: float, attempts: int = 2) -> None:
       crashes.append({"returncode": res.returncode,
                       "stderr_tail": tail})
       if attempt + 1 < max(1, attempts):
-        time.sleep(retry_sleep_s)
+        time.sleep(min(retry_sleep_s, max(0.0,
+                                          deadline - time.monotonic())))
       continue
     line = _extract_json_line(res.stdout)
     if line is None:
       _emit_error_line("bench_output_unparseable", **_extra())
       return
+    if probed_device_kind is not None:
+      try:
+        obj = json.loads(line)
+        obj.setdefault("probed_device_kind", probed_device_kind)
+        line = json.dumps(obj)
+      except ValueError:
+        pass  # _extract_json_line already vetted it; belt only
     print(line)
     return
-  _emit_error_line("bench_failed", attempts=crashes)
+  _emit_error_line("bench_failed", **dict(_extra(), crashes=crashes))
 
 
 def _orchestrate() -> None:
@@ -1181,6 +1221,10 @@ def _orchestrate() -> None:
   # well under 180s, and an unknown driver-side timeout must see the
   # contract line, not a killed process. Longer chip-hunting loops
   # belong outside (they can re-run bench.py, which is idempotent).
+  # After a successful probe, T2R_BENCH_INNER_TIMEOUT is the SHARED
+  # total budget for the inner run including its crash-only retry and
+  # sleep (_run_inner), so the end-to-end worst case to a contract line
+  # is probe bound + one inner budget (~51.5 min at defaults), never 2x.
   probe_timeout = float(os.environ.get("T2R_BENCH_PROBE_TIMEOUT", "180"))
   attempts = int(os.environ.get("T2R_BENCH_PROBE_ATTEMPTS", "2"))
   sleep_s = float(os.environ.get("T2R_BENCH_PROBE_SLEEP", "20"))
@@ -1192,7 +1236,7 @@ def _orchestrate() -> None:
                      probe_attempts=outcomes,
                      probe_timeout_s=probe_timeout)
     return
-  _run_inner(inner_timeout)
+  _run_inner(inner_timeout, probed_device_kind=kind)
 
 
 if __name__ == "__main__":

@@ -33,6 +33,33 @@ class AbstractPredictor(abc.ABC):
       self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     """Runs inference on a batched numpy feature dict."""
 
+  def predict_batched(
+      self, features: Dict[str, np.ndarray],
+      ladder=None) -> Dict[str, np.ndarray]:
+    """predict() with the batch dim padded to a bounded size ladder.
+
+    Fleet serving flushes batches of whatever size the deadline caught;
+    calling predict() raw would compile one executable per distinct
+    size (and per CEM sample multiple on the host path). This pads the
+    leading dim up to a fixed rung — a `serving.BucketLadder` when
+    given, else the next power of two — runs predict(), and slices the
+    outputs back, so the executable count stays bounded no matter what
+    request sizes arrive. Padding repeats the last row (numerically
+    benign through normalization layers); padded outputs are dropped.
+    """
+    from tensor2robot_tpu.serving.bucketing import pad_to
+    sizes = {np.asarray(v).shape[0] for v in dict(features).values()}
+    if len(sizes) != 1:
+      raise ValueError(f"inconsistent leading batch dims: {sizes}")
+    n = sizes.pop()
+    bucket = ladder.bucket_for(n) if ladder is not None else (
+        1 << max(0, (n - 1).bit_length()))
+    if bucket == n:
+      return self.predict(features)
+    padded = {k: pad_to(np.asarray(v), bucket)
+              for k, v in dict(features).items()}
+    return {k: v[:n] for k, v in self.predict(padded).items()}
+
   @abc.abstractmethod
   def get_feature_specification(self) -> ts.TensorSpecStruct:
     """The (flat) feature spec predict() expects."""

@@ -91,12 +91,41 @@ def batched_cem_optimize(
   Returns:
     (B, A) best actions, (B,) their scores.
   """
+  batch = jax.tree_util.tree_leaves(states)[0].shape[0]
+  return fleet_cem_optimize(
+      score_fn, states, jax.random.split(rng, batch), action_size,
+      **kwargs)
+
+
+def fleet_cem_optimize(
+    score_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    states: jnp.ndarray,
+    keys: jax.Array,
+    action_size: int,
+    **kwargs,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """CEM over a batch of states with CALLER-supplied per-state keys.
+
+  The serving micro-batcher's determinism contract hangs on this
+  variant: each fleet request carries its own key, so its action
+  depends only on (state, key, model) — never on which other requests
+  shared the flush, the request's position in the batch, or how much
+  bucket padding rode along. `batched_cem_optimize` derives keys by
+  splitting one rng (fine for training-time sweeps); serving must not,
+  or identical requests would change answers across flush compositions.
+
+  Args:
+    score_fn: (state, (N, A) actions) → (N,) scores for ONE state.
+    states: (B, ...) batch of states (pytree leaves batched on axis 0).
+    keys: (B,) PRNG keys, one per state.
+
+  Returns:
+    (B, A) best actions, (B,) their scores.
+  """
   def single(state, key):
     return cem_optimize(
         functools.partial(score_fn, state), key, action_size, **kwargs)
 
-  batch = jax.tree_util.tree_leaves(states)[0].shape[0]
-  keys = jax.random.split(rng, batch)
   return jax.vmap(single)(states, keys)
 
 

@@ -1,0 +1,35 @@
+"""Fleet serving: deadline micro-batching + bucketed executables.
+
+The single-robot CEM loop (research/qtopt/cem.CEMPolicy) keeps one chip
+busy for one client; the reference instead ran robot *fleets* through a
+batched session.run (SURVEY.md §3.3), and Podracer-style architectures
+(PAPERS.md) get TPU inference efficiency the same way — many actors
+feeding one batched on-device step. This package is that layer:
+
+- ``BucketLadder`` (bucketing.py): pad pending requests up to a small
+  fixed ladder of batch sizes so the compiled-executable count is
+  bounded and no request ever triggers a recompile;
+- ``MicroBatcher`` (batcher.py): concurrent clients enqueue frames, the
+  dispatcher flushes when a batch fills or the oldest request's
+  deadline budget expires;
+- ``CEMFleetPolicy`` (policy.py): the sample→score→elite-refit CEM loop
+  vmapped across clients inside ONE compiled program per bucket;
+- ``FleetServer`` (server.py): batcher + policy + per-request latency
+  histograms / occupancy counters, exportable via utils/metric_writer.
+"""
+
+from tensor2robot_tpu.serving.batcher import MicroBatcher
+from tensor2robot_tpu.serving.bucketing import BucketLadder, DEFAULT_LADDER
+from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+from tensor2robot_tpu.serving.server import FleetServer
+from tensor2robot_tpu.serving.stats import LatencyHistogram, ServingStats
+
+__all__ = [
+    "BucketLadder",
+    "CEMFleetPolicy",
+    "DEFAULT_LADDER",
+    "FleetServer",
+    "LatencyHistogram",
+    "MicroBatcher",
+    "ServingStats",
+]

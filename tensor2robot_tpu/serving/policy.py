@@ -1,0 +1,160 @@
+"""CEMFleetPolicy: the QT-Opt control step batched across clients.
+
+One compiled program per ladder bucket runs the whole fleet control
+step — on-device image tiling, all CEM iterations, scoring through the
+Q-function, elite refitting — for up to ``bucket`` clients at once
+(PAPER.md §3.3 ran the reference's robot fleets through exactly such a
+batched session.run). Executables are AOT-compiled once per bucket and
+keyed on the bucket size only: model hot-reloads swap the variables
+*argument*, never the executable, so serving a fleet for days compiles
+``len(ladder)`` programs total.
+
+Per-request determinism: every request carries a uint32 seed; its CEM
+key is ``fold_in(key(policy_seed), seed)`` inside the compiled program,
+so the action for (image, seed) is independent of flush composition,
+batch position, and bucket padding (see cem.fleet_cem_optimize).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.research.qtopt import cem
+from tensor2robot_tpu.serving.bucketing import BucketLadder
+
+
+class CEMFleetPolicy:
+  """Batched CEM serving policy over any predictor with ``q_predicted``.
+
+  Callable: ``policy(images, seeds=None) -> (n, action_size) actions``,
+  n = len(images) <= ladder.max_batch. Without a device-resident entry
+  (``predictor.device_fn``) the policy falls back to a host loop that
+  ships one ``predict_batched`` call per CEM iteration.
+  """
+
+  def __init__(self, predictor, action_size: int = 4,
+               num_samples: int = 64, num_elites: int = 6,
+               iterations: int = 3, seed: int = 0,
+               ladder: Optional[BucketLadder] = None):
+    self._predictor = predictor
+    self._action_size = action_size
+    self._num_samples = num_samples
+    self._num_elites = num_elites
+    self._iterations = iterations
+    self._seed = seed
+    self.ladder = ladder or BucketLadder()
+    self._executables = {}
+    # bucket -> number of compilations; the serving invariant tests
+    # assert every value stays exactly 1 for the life of the policy.
+    self.compile_counts = {}
+    # Separate locks: a first-time bucket compile holds _compile_lock
+    # for seconds — clients assigning request seeds in submit() must
+    # not stall fleet-wide behind it.
+    self._compile_lock = threading.Lock()
+    self._seed_lock = threading.Lock()
+    self._next_seed = 0
+
+  @property
+  def executable_buckets(self) -> Sequence[int]:
+    return sorted(self._executables)
+
+  def assign_seeds(self, n: int) -> np.ndarray:
+    """n fresh monotonic request seeds (thread-safe)."""
+    with self._seed_lock:
+      start = self._next_seed
+      self._next_seed += n
+    return np.arange(start, start + n, dtype=np.uint32)
+
+  def __call__(self, images: Sequence[np.ndarray],
+               seeds: Optional[Sequence[int]] = None) -> np.ndarray:
+    batch = np.stack([np.asarray(image) for image in images])
+    n = batch.shape[0]
+    seeds = (self.assign_seeds(n) if seeds is None
+             else np.asarray(seeds, np.uint32))
+    if seeds.shape != (n,):
+      raise ValueError(f"need {n} seeds, got shape {seeds.shape}")
+    try:
+      fn, variables = self._predictor.device_fn()
+    except NotImplementedError:
+      return self._host_call(batch, seeds)
+    padded, bucket = self.ladder.pad_batch(batch)
+    padded_seeds, _ = self.ladder.pad_batch(seeds)
+    compiled = self._executable_for(bucket, fn, variables, padded,
+                                    padded_seeds)
+    actions = compiled(variables, jnp.asarray(padded),
+                       jnp.asarray(padded_seeds))
+    return np.asarray(actions)[:n]
+
+  # -- compiled path -------------------------------------------------------
+
+  def _build_control(self, fn):
+    """(variables, (B,...) images, (B,) seeds) → (B, A) actions."""
+    num_samples = self._num_samples
+
+    def control(variables, images, seeds):
+      base = jax.random.key(self._seed)
+      keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(seeds)
+
+      def score(image, actions):
+        # Tile ONE client's image across its candidate actions; under
+        # the fleet vmap this becomes one (B*num_samples) Q call per
+        # CEM iteration — the Podracer-style batched on-device step.
+        tiled = jnp.broadcast_to(image[None],
+                                 (actions.shape[0],) + image.shape)
+        outputs = fn(variables, {"image": tiled,
+                                 "action": actions.astype(jnp.float32)})
+        return jnp.reshape(outputs["q_predicted"], (-1,))
+
+      best, _ = cem.fleet_cem_optimize(
+          score, images, keys, self._action_size,
+          num_samples=num_samples, num_elites=self._num_elites,
+          iterations=self._iterations)
+      return best
+
+    return control
+
+  def _executable_for(self, bucket, fn, variables, padded, padded_seeds):
+    with self._compile_lock:
+      compiled = self._executables.get(bucket)
+      if compiled is None:
+        lowered = jax.jit(self._build_control(fn)).lower(
+            variables, jnp.asarray(padded), jnp.asarray(padded_seeds))
+        compiled = lowered.compile()
+        self._executables[bucket] = compiled
+        self.compile_counts[bucket] = (
+            self.compile_counts.get(bucket, 0) + 1)
+    return compiled
+
+  # -- host fallback -------------------------------------------------------
+
+  def _host_call(self, batch: np.ndarray, seeds: np.ndarray) -> np.ndarray:
+    """predict_batched()-based fleet CEM: mirrors cem_optimize's sampling
+    per state (same fold_in sequence), so host and device paths agree
+    the way CEMPolicy's do; the flat (B*num_samples) scoring batch goes
+    through predict_batched, which bounds ITS executable count too."""
+    num = self._num_samples
+    b = batch.shape[0]
+    base = jax.random.key(self._seed)
+    keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
+        jnp.asarray(seeds))
+    mean = jnp.zeros((b, self._action_size), jnp.float32)
+    std = jnp.full((b, self._action_size), 0.5, jnp.float32)
+    tiled = np.repeat(batch, num, axis=0)
+    refit = jax.vmap(cem._refit, in_axes=(0, 0, None))
+    for i in range(self._iterations):
+      step_keys = jax.vmap(lambda k: jax.random.fold_in(k, i))(keys)
+      noise = jax.vmap(
+          lambda k: jax.random.normal(k, (num, self._action_size)))(
+              step_keys)
+      samples = jnp.clip(mean[:, None] + std[:, None] * noise, -1.0, 1.0)
+      outputs = self._predictor.predict_batched({
+          "image": tiled,
+          "action": np.asarray(samples, np.float32).reshape(b * num, -1)})
+      scores = jnp.asarray(outputs["q_predicted"]).reshape(b, num)
+      mean, std = refit(samples, scores, self._num_elites)
+    return np.asarray(jnp.clip(mean, -1.0, 1.0))

@@ -1,0 +1,101 @@
+"""FleetServer: micro-batcher + bucketed fleet policy + observability.
+
+The robot-facing composition: N clients call ``submit(image)`` (or the
+blocking ``act``) from their own threads; the dispatcher flushes their
+frames into one ``CEMFleetPolicy`` call per batch — padded to the
+bucket ladder, so the whole fleet is served by a bounded set of
+compiled programs — and every request's latency lands in the stats
+histograms that back the ``SERVING_r*`` artifact's fleet fields.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from tensor2robot_tpu.serving.batcher import MicroBatcher
+from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+from tensor2robot_tpu.serving.stats import ServingStats
+
+
+class FleetServer:
+  """Serves one CEMFleetPolicy to many concurrent clients."""
+
+  def __init__(self, policy: CEMFleetPolicy,
+               max_batch: Optional[int] = None,
+               deadline_ms: float = 5.0,
+               stats: Optional[ServingStats] = None,
+               metric_writer=None):
+    """Args:
+      policy: the batched control step (owns the bucket ladder).
+      max_batch: flush threshold; defaults to the ladder's top rung and
+        must not exceed it (a larger flush could not be bucketed).
+      deadline_ms: max time the oldest queued frame waits before a
+        partial flush — the lone-robot latency budget.
+      stats: shared ServingStats (one is created if not given).
+      metric_writer: optional utils.metric_writer.MetricWriter; when
+        given, ``write_metrics(step)`` routes snapshots through it.
+    """
+    max_batch = policy.ladder.max_batch if max_batch is None else max_batch
+    if max_batch > policy.ladder.max_batch:
+      raise ValueError(
+          f"max_batch {max_batch} exceeds ladder top rung "
+          f"{policy.ladder.max_batch}")
+    self._policy = policy
+    self.stats = stats or ServingStats()
+    self._metric_writer = metric_writer
+    self._metric_step = 0
+    self._batcher = MicroBatcher(
+        self._flush, max_batch=max_batch, deadline_ms=deadline_ms,
+        stats=self.stats, bucket_for=policy.ladder.bucket_for)
+
+  # -- lifecycle -----------------------------------------------------------
+
+  def start(self) -> "FleetServer":
+    self._batcher.start()
+    return self
+
+  def stop(self) -> None:
+    self._batcher.stop()
+
+  def __enter__(self) -> "FleetServer":
+    return self.start()
+
+  def __exit__(self, *exc_info) -> None:
+    self.stop()
+
+  # -- client API ----------------------------------------------------------
+
+  def submit(self, image) -> Future:
+    """Enqueues one camera frame; resolves to its (action_size,) action."""
+    seed = int(self._policy.assign_seeds(1)[0])
+    return self._batcher.submit((np.asarray(image), seed))
+
+  def act(self, image, timeout: Optional[float] = None) -> np.ndarray:
+    """Blocking control step: the closed-loop client call."""
+    return self.submit(image).result(timeout)
+
+  # -- internals / observability ------------------------------------------
+
+  def _flush(self, items):
+    images = [item[0] for item in items]
+    seeds = np.asarray([item[1] for item in items], np.uint32)
+    actions = self._policy(images, seeds)
+    return list(actions)
+
+  def snapshot(self) -> dict:
+    """Stats snapshot + the compiled-executable ledger."""
+    out = self.stats.snapshot()
+    out["executable_buckets"] = list(self._policy.executable_buckets)
+    out["compile_counts"] = dict(self._policy.compile_counts)
+    return out
+
+  def write_metrics(self, step: Optional[int] = None) -> None:
+    if self._metric_writer is None:
+      return
+    if step is None:
+      step = self._metric_step
+      self._metric_step += 1
+    self.stats.write_to(self._metric_writer, step)

@@ -1,0 +1,85 @@
+"""TinyQPredictor: a millisecond-scale Q-function for serving smokes.
+
+The CPU `--fleet --smoke` lane (bin/bench_serving) and the tier-1
+serving tests need a predictor whose per-sample compute is negligible,
+so what they measure/assert is the SERVING layer — dispatch
+amortization, deadline flushing, bucket padding — not conv throughput
+this box doesn't have. The Q-function has a known per-image optimum
+(``q = -||action - tanh(image @ w)||²``), which lets tests verify that
+each fleet request got an answer for ITS OWN image: any cross-request
+mixup in the batcher or the vmapped CEM shows up as a wrong optimum,
+not just a slow one.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class TinyQPredictor(AbstractPredictor):
+  """(image, action) → q_predicted with an analytically known argmax."""
+
+  def __init__(self, image_size: int = 8, action_size: int = 4,
+               seed: int = 0):
+    self.image_size = image_size
+    self.action_size = action_size
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal(
+        (image_size * image_size * 3, action_size)).astype(np.float32)
+    self._variables = {"params": {"w": jnp.asarray(0.05 * w)}}
+    self._predict = jax.jit(self._fn)
+
+  @staticmethod
+  def _fn(variables, features):
+    image = jnp.asarray(features["image"], jnp.float32)
+    flat = image.reshape((image.shape[0], -1))
+    target = jnp.tanh(flat @ variables["params"]["w"])
+    action = jnp.asarray(features["action"], jnp.float32)
+    q = -jnp.sum((action - target) ** 2, axis=-1)
+    return {"q_predicted": q}
+
+  def best_action(self, image: np.ndarray) -> np.ndarray:
+    """The analytic optimum CEM should find for `image`."""
+    flat = np.asarray(image, np.float32).reshape(1, -1)
+    return np.tanh(flat @ np.asarray(self._variables["params"]["w"]))[0]
+
+  def make_image(self, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.random(
+        (self.image_size, self.image_size, 3)).astype(np.float32)
+
+  # -- AbstractPredictor contract -----------------------------------------
+
+  def restore(self, timeout_s: float = 0.0) -> bool:
+    return True
+
+  def init_randomly(self) -> None:
+    pass
+
+  def predict(
+      self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    outputs = self._predict(self._variables, dict(features))
+    return {k: np.asarray(v) for k, v in outputs.items()}
+
+  def device_fn(self):
+    return self._fn, self._variables
+
+  def get_feature_specification(self) -> ts.TensorSpecStruct:
+    return ts.TensorSpecStruct({
+        "image": ts.ExtendedTensorSpec(
+            (self.image_size, self.image_size, 3), np.float32,
+            name="image"),
+        "action": ts.ExtendedTensorSpec(
+            (self.action_size,), np.float32, name="action"),
+    })
+
+  @property
+  def model_version(self) -> int:
+    return 0

@@ -1,0 +1,122 @@
+"""Serving observability: latency histograms + batching counters.
+
+The fleet numbers the artifact schema carries (docs/ARTIFACTS.md
+serving row): per-request latency p50/p99, queue depth at flush, batch
+occupancy (real requests / compiled bucket slots), and padding waste.
+Everything is plain host floats, so a snapshot can go straight into
+``utils/metric_writer.MetricWriter.write_scalars`` or a JSON artifact.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+from typing import Dict, Optional
+
+
+def _nearest_rank(ordered, pct: float) -> float:
+  """Nearest-rank percentile: smallest sample with >= pct% at or below."""
+  rank = min(len(ordered) - 1,
+             max(0, math.ceil(pct / 100.0 * len(ordered)) - 1))
+  return ordered[rank]
+
+
+class LatencyHistogram:
+  """Bounded reservoir of latency samples with percentile readout."""
+
+  def __init__(self, max_samples: int = 16384):
+    self._samples: collections.deque = collections.deque(maxlen=max_samples)
+    self._lock = threading.Lock()
+
+  def record(self, latency_ms: float) -> None:
+    with self._lock:
+      self._samples.append(float(latency_ms))
+
+  def percentile(self, pct: float) -> Optional[float]:
+    with self._lock:
+      if not self._samples:
+        return None
+      ordered = sorted(self._samples)
+    return _nearest_rank(ordered, pct)
+
+  def summary(self, digits: int = 3) -> Dict[str, float]:
+    with self._lock:
+      samples = list(self._samples)
+    if not samples:
+      return {"count": 0}
+    ordered = sorted(samples)
+
+    def at(pct):
+      return round(_nearest_rank(ordered, pct), digits)
+
+    return {
+        "count": len(samples),
+        "p50_ms": at(50),
+        "p90_ms": at(90),
+        "p99_ms": at(99),
+        "max_ms": round(ordered[-1], digits),
+        "mean_ms": round(sum(samples) / len(samples), digits),
+    }
+
+
+class ServingStats:
+  """Thread-safe counters for the micro-batching serving path."""
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self.latency = LatencyHistogram()
+    self._requests = 0
+    self._flushes = 0
+    self._occupied_slots = 0   # sum of real requests over flushes
+    self._padded_slots = 0     # sum of compiled bucket sizes over flushes
+    self._deadline_flushes = 0  # flushed by deadline, not by a full batch
+    self._queue_depth_sum = 0   # queue depth left behind at flush time
+
+  def record_request(self) -> None:
+    with self._lock:
+      self._requests += 1
+
+  def record_flush(self, batch_size: int, bucket: int,
+                   queue_depth_after: int, deadline_expired: bool) -> None:
+    with self._lock:
+      self._flushes += 1
+      self._occupied_slots += int(batch_size)
+      self._padded_slots += int(bucket)
+      self._queue_depth_sum += int(queue_depth_after)
+      if deadline_expired:
+        self._deadline_flushes += 1
+
+  def record_latency_ms(self, latency_ms: float) -> None:
+    self.latency.record(latency_ms)
+
+  def snapshot(self) -> Dict[str, float]:
+    """One flat dict: counters + derived ratios + latency percentiles."""
+    with self._lock:
+      flushes = self._flushes
+      out = {
+          "requests": self._requests,
+          "flushes": flushes,
+          "deadline_flushes": self._deadline_flushes,
+          "batch_occupancy": round(
+              self._occupied_slots / self._padded_slots, 4)
+          if self._padded_slots else None,
+          "padding_waste": round(
+              1.0 - self._occupied_slots / self._padded_slots, 4)
+          if self._padded_slots else None,
+          "mean_batch_size": round(self._occupied_slots / flushes, 3)
+          if flushes else None,
+          "mean_queue_depth_after_flush": round(
+              self._queue_depth_sum / flushes, 3) if flushes else None,
+      }
+    for key, value in self.latency.summary().items():
+      out["latency_" + key if not key.startswith("count") else
+          "latency_samples"] = value
+    return out
+
+  def write_to(self, metric_writer, step: int,
+               prefix: str = "serving/") -> None:
+    """Routes the snapshot's numeric fields through a MetricWriter."""
+    scalars = {prefix + k: v for k, v in self.snapshot().items()
+               if isinstance(v, (int, float)) and v is not None}
+    metric_writer.write_scalars(step, scalars)

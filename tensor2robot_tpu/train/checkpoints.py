@@ -103,6 +103,15 @@ class CheckpointManager:
     view), and a step dir with no subdirectories yet is skipped as
     evidence-free — caching False from a half-visible dir would
     permanently disarm the probe and reopen the poisoning bug.
+
+    A mid-write step dir can also expose subdirectories WITHOUT being
+    conclusive (ADVICE r5): orbax materializes the item dir under a tmp
+    name first ('default.orbax-checkpoint-tmp-<ts>'), so a dir whose
+    only subdirs carry the tmp marker must not teach False either. Any
+    'default'-prefixed name (finalized or tmp) is evidence FOR the
+    default layout; a dir with only non-default tmp names is skipped as
+    inconclusive; False is learned only from a dir holding exclusively
+    finalized non-default subdirs.
     """
     if self._default_layout is None:
       for s in sorted(self.all_steps()):
@@ -116,7 +125,13 @@ class CheckpointManager:
           continue
         if not subdirs:
           continue
-        self._default_layout = "default" in subdirs
+        if any(e == "default" or e.startswith("default.")
+               for e in subdirs):
+          self._default_layout = True
+          break
+        if any("orbax-checkpoint-tmp" in e for e in subdirs):
+          continue  # mid-write: not evidence of a non-default layout
+        self._default_layout = False
         break
     return self._default_layout
 

@@ -123,7 +123,10 @@ class TestOrchestratorOutage:
     # Bounded: import (~seconds) + 2s probe kill, nowhere near 600s.
     assert time.monotonic() - start < 90
 
-  def test_success_path_forwards_inner_line_verbatim(self):
+  def test_success_path_forwards_inner_line_with_probed_kind(self):
+    """The inner contract line is forwarded intact, annotated with the
+    probed device_kind (ADVICE r5: a CPU fallback must be detectable
+    from the emitted line alone)."""
     inner_line = json.dumps({
         "metric": "fake", "value": 1, "unit": "x", "vs_baseline": 2.0})
     res = _run_bench_cli({
@@ -132,7 +135,34 @@ class TestOrchestratorOutage:
             "print('compile log noise'); print(%r)" % inner_line),
     })
     obj = self._parse_single_line(res)
+    assert obj.pop("probed_device_kind") == "FakeTPU v5"
     assert obj == json.loads(inner_line)
+
+  def test_cpu_probe_is_rejected(self):
+    """ADVICE r5: a probe that lands on the CPU backend must NOT count
+    as a successful chip claim — no CPU-measured numbers can reach the
+    headline without an explicit opt-in."""
+    res = _run_bench_cli({
+        "T2R_BENCH_PROBE_SNIPPET": "print('cpu')",
+        "T2R_BENCH_PROBE_ATTEMPTS": "2",
+        "T2R_BENCH_PROBE_SLEEP": "0",
+    })
+    obj = self._parse_single_line(res)
+    assert obj["error"] == "tpu_pool_unavailable"
+    # Deterministic outcome: no pointless second attempt or sleep.
+    assert obj["probe_attempts"] == ["cpu_fallback"]
+
+  def test_cpu_probe_allowed_with_explicit_override(self):
+    inner_line = json.dumps({
+        "metric": "fake", "value": 1, "unit": "x", "vs_baseline": 2.0})
+    res = _run_bench_cli({
+        "T2R_BENCH_PROBE_SNIPPET": "print('cpu')",
+        "T2R_BENCH_ALLOW_CPU": "1",
+        "T2R_BENCH_INNER_SNIPPET": "print(%r)" % inner_line,
+    })
+    obj = self._parse_single_line(res)
+    # The override still marks the line: the driver can see it ran on cpu.
+    assert obj["probed_device_kind"] == "cpu"
 
   def test_inner_crash_is_retried_then_reported_with_both_attempts(self):
     res = _run_bench_cli({
@@ -144,11 +174,41 @@ class TestOrchestratorOutage:
     })
     obj = self._parse_single_line(res)
     assert obj["error"] == "bench_failed"
-    # Crash-only retry: both attempts' diagnostics preserved.
-    assert len(obj["attempts"]) == 2
-    for crash in obj["attempts"]:
+    # Crash-only retry: both attempts' diagnostics under the ONE
+    # crash-diagnostics key every error path shares (ADVICE r5).
+    assert len(obj["crashes"]) == 2
+    for crash in obj["crashes"]:
       assert crash["returncode"] == 3
       assert "boom-reason" in crash["stderr_tail"]
+
+  def test_inner_retry_budget_is_shared_not_doubled(self, tmp_path):
+    """ADVICE r5: T2R_BENCH_INNER_TIMEOUT is a total budget — a crash
+    that burns part of it leaves the retry only the remainder, so the
+    contract line appears within ~one budget, never two."""
+    marker = tmp_path / "first_attempt_done"
+    # First attempt: instant crash (triggers the retry). Second
+    # attempt: hangs — must be killed at the REMAINING budget (~4s),
+    # not given a fresh per-attempt 5s (let alone an unbounded one).
+    snippet = (
+        "import os, sys, time\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "  open(m, 'w').close(); sys.exit(3)\n"
+        "time.sleep(600)\n")
+    start = time.monotonic()
+    res = _run_bench_cli({
+        "T2R_BENCH_PROBE_SNIPPET": "print('FakeTPU v5')",
+        "T2R_BENCH_INNER_SNIPPET": snippet,
+        "T2R_BENCH_INNER_TIMEOUT": "5",
+        "T2R_BENCH_RETRY_SLEEP": "0",
+    })
+    obj = self._parse_single_line(res)
+    # The hang hits the shared deadline -> timeout line carrying the
+    # first attempt's crash diagnostics.
+    assert obj["error"] == "bench_timeout"
+    assert len(obj["crashes"]) == 1
+    assert obj["probed_device_kind"] == "FakeTPU v5"
+    assert time.monotonic() - start < 60
 
   def test_transient_inner_failure_is_retried_once(self, tmp_path):
     """A mid-run pool flap (probe ok, inner dies) must not forfeit the
@@ -168,6 +228,7 @@ class TestOrchestratorOutage:
         "T2R_BENCH_RETRY_SLEEP": "0",
     })
     obj = self._parse_single_line(res)
+    assert obj.pop("probed_device_kind") == "FakeTPU v5"
     assert obj == json.loads(inner_line)
 
   def test_inner_hang_becomes_timeout_line(self):

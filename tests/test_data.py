@@ -557,6 +557,37 @@ class TestPrefetch:
     assert out[0].sharding == sharding
     np.testing.assert_array_equal(np.asarray(out[0]), batches[0])
 
+  @pytest.mark.parametrize("depth", [1, 2, 4])
+  def test_prefetch_ordering_and_depth(self, depth):
+    """Regression (ISSUE 1 satellite): yields stay in source order, and
+    exactly `depth` transfers are in flight — pulling batch N+depth
+    from the host iterator must not happen before batch N is yielded
+    (that's the double-buffering window, not an unbounded slurp)."""
+    from tensor2robot_tpu.data.prefetch import prefetch_to_device
+
+    pulled = []
+
+    def source(n=6):
+      for i in range(n):
+        pulled.append(i)
+        yield {"x": np.full((2,), i, np.float32)}
+
+    it = prefetch_to_device(source(), depth=depth)
+    first = next(it)
+    # The first yield happens once `depth` batches are in flight —
+    # no more (HBM bound), no fewer (the overlap the buffer exists for).
+    assert pulled == list(range(depth))
+    assert float(np.asarray(first["x"])[0]) == 0.0
+    rest = list(it)
+    assert pulled == list(range(6))
+    values = [float(np.asarray(b["x"])[0]) for b in [first] + rest]
+    assert values == [float(i) for i in range(6)]
+
+  def test_prefetch_rejects_bad_depth(self):
+    from tensor2robot_tpu.data.prefetch import prefetch_to_device
+    with pytest.raises(ValueError, match="depth"):
+      next(prefetch_to_device(iter([]), depth=0))
+
 
 class TestIteratorShutdown:
 

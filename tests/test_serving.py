@@ -1,0 +1,433 @@
+"""Fleet serving layer: batcher, bucketing, batched CEM, smoke CLI.
+
+CPU-mesh tests for the properties the serving subsystem exists to
+provide (ISSUE 1): deadline-driven flushing, bucket padding that never
+recompiles within the ladder, FIFO fairness, per-request determinism
+(a request's action is independent of flush composition), and the
+`--fleet --smoke` CLI lane that exercises the whole path — micro-batch
+amortization included — on every PR without a TPU.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestBucketLadder:
+
+  def test_bucket_for(self):
+    from tensor2robot_tpu.serving.bucketing import BucketLadder
+    ladder = BucketLadder((1, 2, 4, 8, 16))
+    assert [ladder.bucket_for(n) for n in (1, 2, 3, 4, 5, 8, 9, 16)] == [
+        1, 2, 4, 4, 8, 8, 16, 16]
+    with pytest.raises(ValueError):
+      ladder.bucket_for(0)
+    with pytest.raises(ValueError):
+      ladder.bucket_for(17)
+
+  def test_pad_batch_repeats_last_row(self):
+    from tensor2robot_tpu.serving.bucketing import BucketLadder
+    ladder = BucketLadder((1, 2, 4))
+    batch = np.arange(6, dtype=np.float32).reshape(3, 2)
+    padded, bucket = ladder.pad_batch(batch)
+    assert bucket == 4 and padded.shape == (4, 2)
+    np.testing.assert_array_equal(padded[:3], batch)
+    np.testing.assert_array_equal(padded[3], batch[2])
+    exact, bucket = ladder.pad_batch(batch[:2])
+    assert bucket == 2 and exact.shape == (2, 2)
+
+  def test_invalid_ladder(self):
+    from tensor2robot_tpu.serving.bucketing import BucketLadder
+    with pytest.raises(ValueError):
+      BucketLadder(())
+    with pytest.raises(ValueError):
+      BucketLadder((0, 2))
+
+
+class TestLatencyHistogram:
+
+  def test_percentiles(self):
+    from tensor2robot_tpu.serving.stats import LatencyHistogram
+    hist = LatencyHistogram()
+    for v in range(1, 101):  # 1..100 ms
+      hist.record(float(v))
+    summary = hist.summary()
+    assert summary["count"] == 100
+    assert summary["p50_ms"] == 50.0
+    assert summary["p99_ms"] == 99.0
+    assert summary["max_ms"] == 100.0
+
+  def test_empty(self):
+    from tensor2robot_tpu.serving.stats import LatencyHistogram
+    assert LatencyHistogram().summary() == {"count": 0}
+    assert LatencyHistogram().percentile(50) is None
+
+
+class TestMicroBatcher:
+
+  def _collecting_batcher(self, flush_sizes, **kwargs):
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+
+    def batch_fn(items):
+      flush_sizes.append(len(items))
+      return list(items)  # identity: result == submitted item
+
+    return MicroBatcher(batch_fn, **kwargs)
+
+  def test_deadline_flushes_partial_batch(self):
+    """A lone client's frame must not wait for a batch that will never
+    fill: the flush fires once the oldest request's budget expires."""
+    sizes = []
+    with self._collecting_batcher(sizes, max_batch=8,
+                                  deadline_ms=30.0) as batcher:
+      start = time.perf_counter()
+      futures = [batcher.submit(i) for i in (10, 11, 12)]
+      results = [f.result(timeout=10) for f in futures]
+      elapsed = time.perf_counter() - start
+    assert results == [10, 11, 12]
+    assert sizes == [3]          # one partial flush, not three singles
+    assert elapsed >= 0.025      # ... but only after the deadline budget
+    assert elapsed < 5.0
+
+  def test_full_batch_flushes_immediately(self):
+    """max_batch pending requests flush without waiting the deadline."""
+    sizes = []
+    with self._collecting_batcher(sizes, max_batch=4,
+                                  deadline_ms=10_000.0) as batcher:
+      futures = [batcher.submit(i) for i in range(8)]
+      results = [f.result(timeout=10) for f in futures]
+    assert results == list(range(8))
+    assert sizes == [4, 4]       # never waited the 10s deadline
+
+  def test_fifo_fairness(self):
+    """Flushes take the HEAD of the queue: early requests are never
+    starved by later arrivals, and results map back to their futures."""
+    order = []
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+
+    def batch_fn(items):
+      order.extend(items)
+      time.sleep(0.005)  # keep a backlog while more requests arrive
+      return [item * 100 for item in items]
+
+    with MicroBatcher(batch_fn, max_batch=2, deadline_ms=5.0) as batcher:
+      futures = [batcher.submit(i) for i in range(10)]
+      results = [f.result(timeout=10) for f in futures]
+    assert order == sorted(order), f"flushes reordered requests: {order}"
+    assert results == [i * 100 for i in range(10)]
+
+  def test_batch_fn_exception_fails_only_that_flush(self):
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+    calls = {"n": 0}
+
+    def flaky(items):
+      calls["n"] += 1
+      if calls["n"] == 1:
+        raise RuntimeError("boom")
+      return list(items)
+
+    with MicroBatcher(flaky, max_batch=2, deadline_ms=5.0) as batcher:
+      first = [batcher.submit(i) for i in range(2)]
+      for f in first:
+        with pytest.raises(RuntimeError):
+          f.result(timeout=10)
+      # The dispatcher survived; the next flush succeeds.
+      assert batcher.submit(7).result(timeout=10) == 7
+
+  def test_cancelled_request_does_not_kill_dispatcher(self):
+    """A client that gives up (future.cancel() after a result timeout)
+    must not poison the flush: the cancelled request is dropped and the
+    dispatcher keeps serving everyone else (regression: set_result on a
+    cancelled future raised on the dispatcher thread and hung the
+    whole batcher)."""
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+    release = threading.Event()
+
+    def slow(items):
+      release.wait(5)
+      return list(items)
+
+    with MicroBatcher(slow, max_batch=4, deadline_ms=1.0) as batcher:
+      first = batcher.submit(1)   # deadline-flushes alone; blocks in slow
+      time.sleep(0.05)
+      second = batcher.submit(2)  # queued behind the in-flight flush
+      assert second.cancel()      # client gives up while still pending
+      release.set()
+      assert first.result(timeout=10) == 1
+      # The dispatcher survived the cancelled request.
+      assert batcher.submit(3).result(timeout=10) == 3
+    assert second.cancelled()
+
+  def test_stop_drains_queue(self):
+    sizes = []
+    batcher = self._collecting_batcher(sizes, max_batch=4,
+                                       deadline_ms=10_000.0)
+    batcher.start()
+    futures = [batcher.submit(i) for i in range(3)]
+    batcher.stop()  # queue below max_batch, deadline far away: drained
+    assert [f.result(timeout=1) for f in futures] == [0, 1, 2]
+    with pytest.raises(RuntimeError):
+      batcher.submit(99)
+
+  def test_stats_recorded(self):
+    from tensor2robot_tpu.serving.stats import ServingStats
+    stats = ServingStats()
+    sizes = []
+    with self._collecting_batcher(
+        sizes, max_batch=8, deadline_ms=20.0, stats=stats,
+        bucket_for=lambda n: 8) as batcher:
+      [f.result(timeout=10) for f in [batcher.submit(i) for i in range(3)]]
+    snap = stats.snapshot()
+    assert snap["requests"] == 3
+    assert snap["flushes"] == 1
+    assert snap["deadline_flushes"] == 1
+    assert snap["batch_occupancy"] == pytest.approx(3 / 8)
+    assert snap["padding_waste"] == pytest.approx(5 / 8)
+    assert snap["latency_samples"] == 3
+    # Waited out the ~20ms deadline (small slack: cond.wait may return
+    # a hair early on coarse clocks).
+    assert snap["latency_p50_ms"] >= 18.0
+
+
+@pytest.fixture(scope="module")
+def tiny_predictor():
+  from tensor2robot_tpu.serving.smoke import TinyQPredictor
+  return TinyQPredictor(image_size=8, action_size=4, seed=0)
+
+
+@pytest.fixture(scope="module")
+def fleet_policy(tiny_predictor):
+  from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+  return CEMFleetPolicy(tiny_predictor, action_size=4, num_samples=64,
+                        num_elites=6, iterations=3, seed=0)
+
+
+class TestCEMFleetPolicy:
+
+  def test_bucketed_execution_never_recompiles_within_ladder(
+      self, fleet_policy, tiny_predictor):
+    """Every batch size in 1..16 is served by the fixed ladder with
+    EXACTLY one compiled executable per bucket — the bounded-signature
+    property (pjit playbook) the ladder exists for."""
+    for n in (1, 2, 3, 4, 5, 7, 8, 11, 16, 3, 16, 1):
+      images = [tiny_predictor.make_image(i) for i in range(n)]
+      actions = fleet_policy(images)
+      assert actions.shape == (n, 4)
+    assert list(fleet_policy.executable_buckets) == [1, 2, 4, 8, 16]
+    assert all(count == 1
+               for count in fleet_policy.compile_counts.values()), (
+                   fleet_policy.compile_counts)
+
+  def test_per_request_results_independent_of_flush_composition(
+      self, fleet_policy, tiny_predictor):
+    """A request's action depends on (image, seed) only — not on batch
+    position, co-batched requests, or bucket padding."""
+    images = [tiny_predictor.make_image(i) for i in range(3)]
+    seeds = [5, 9, 13]
+    together = fleet_policy(images, seeds)          # bucket 4 (padded)
+    alone = np.concatenate([
+        fleet_policy([img], [seed])                 # bucket 1
+        for img, seed in zip(images, seeds)])
+    np.testing.assert_allclose(together, alone, atol=1e-4)
+    reversed_out = fleet_policy(images[::-1], seeds[::-1])
+    np.testing.assert_allclose(together, reversed_out[::-1], atol=1e-4)
+
+  def test_cem_finds_each_requests_own_optimum(self, fleet_policy,
+                                               tiny_predictor):
+    """Each fleet request converges toward ITS image's analytic argmax:
+    any cross-request mixup in the vmapped CEM or the padding would
+    drag an action toward a different request's optimum."""
+    images = [tiny_predictor.make_image(100 + i) for i in range(5)]
+    optima = np.stack([tiny_predictor.best_action(im) for im in images])
+    actions = fleet_policy(images)
+    for i, action in enumerate(actions):
+      distances = np.linalg.norm(optima - action, axis=-1)
+      assert np.argmin(distances) == i, (
+          f"request {i} answered toward optimum {np.argmin(distances)}")
+
+  def test_host_fallback_matches_device_path(self, tiny_predictor):
+    """Without device_fn the policy scores through predict_batched; the
+    sampling sequence mirrors the compiled path, so both agree (the
+    fleet version of CEMPolicy's device/host parity test)."""
+    from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+
+    class HostOnly:
+      def __init__(self, inner):
+        self._inner = inner
+
+      def device_fn(self):
+        raise NotImplementedError
+
+      def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    kwargs = dict(action_size=4, num_samples=32, num_elites=4,
+                  iterations=2, seed=3)
+    images = [tiny_predictor.make_image(i) for i in range(3)]
+    seeds = [2, 4, 6]
+    device_out = CEMFleetPolicy(tiny_predictor, **kwargs)(images, seeds)
+    host_out = CEMFleetPolicy(HostOnly(tiny_predictor), **kwargs)(
+        images, seeds)
+    np.testing.assert_allclose(device_out, host_out, atol=1e-4)
+
+
+class TestPredictBatched:
+
+  def test_pads_to_bounded_bucket_and_slices_back(self, tiny_predictor):
+    seen_sizes = []
+    inner_predict = tiny_predictor.predict
+
+    class Recording:
+      def __getattr__(self, name):
+        return getattr(tiny_predictor, name)
+
+      def predict(self, features):
+        seen_sizes.append(np.asarray(features["image"]).shape[0])
+        return inner_predict(features)
+
+    from tensor2robot_tpu.predictors.abstract_predictor import (
+        AbstractPredictor)
+    recording = Recording()
+    images = np.stack([tiny_predictor.make_image(i) for i in range(5)])
+    actions = np.zeros((5, 4), np.float32)
+    out = AbstractPredictor.predict_batched(
+        recording, {"image": images, "action": actions})
+    # 5 rows ran as one power-of-two bucket of 8; outputs sliced to 5
+    # and equal to the unpadded answer row-for-row.
+    assert seen_sizes == [8]
+    assert out["q_predicted"].shape == (5,)
+    direct = tiny_predictor.predict(
+        {"image": images, "action": actions})
+    np.testing.assert_allclose(out["q_predicted"],
+                               direct["q_predicted"], atol=1e-6)
+
+  def test_inconsistent_batch_dims_rejected(self, tiny_predictor):
+    with pytest.raises(ValueError):
+      tiny_predictor.predict_batched({
+          "image": np.zeros((2, 8, 8, 3), np.float32),
+          "action": np.zeros((3, 4), np.float32)})
+
+
+class TestFleetServer:
+
+  def test_concurrent_clients_get_their_own_answers(self, fleet_policy,
+                                                    tiny_predictor):
+    """16 threads × distinct images through the full stack; every
+    client's action lands nearest its own optimum, and the stats carry
+    the occupancy/latency fields the artifact schema names."""
+    from tensor2robot_tpu.serving.server import FleetServer
+    n_clients, frames = 16, 4
+    images = [tiny_predictor.make_image(200 + i) for i in range(n_clients)]
+    optima = np.stack([tiny_predictor.best_action(im) for im in images])
+    results = [None] * n_clients
+    errors = []
+
+    server = FleetServer(fleet_policy, max_batch=16, deadline_ms=20.0)
+
+    def client(i):
+      try:
+        for _ in range(frames):
+          results[i] = server.act(images[i], timeout=30)
+      except Exception as e:
+        errors.append(e)
+
+    with server:
+      threads = [threading.Thread(target=client, args=(i,))
+                 for i in range(n_clients)]
+      for t in threads:
+        t.start()
+      for t in threads:
+        t.join()
+    assert not errors, errors
+    # Every client's action converged near ITS OWN optimum (own-dist
+    # stays well under the ~1.0 typical inter-optima distance a result
+    # mixup would show; exact batched-vs-unbatched equality is pinned
+    # in TestCEMFleetPolicy).
+    for i, action in enumerate(results):
+      own = float(np.linalg.norm(action - optima[i]))
+      assert own < 0.75, (i, own)
+    snap = server.snapshot()
+    assert snap["requests"] == n_clients * frames
+    assert snap["latency_samples"] == n_clients * frames
+    assert snap["latency_p50_ms"] is not None
+    assert snap["latency_p99_ms"] >= snap["latency_p50_ms"]
+    assert 0 < snap["batch_occupancy"] <= 1
+    assert set(snap["executable_buckets"]) <= {1, 2, 4, 8, 16}
+
+  def test_metric_writer_integration(self, fleet_policy, tiny_predictor,
+                                     tmp_path):
+    from tensor2robot_tpu.serving.server import FleetServer
+    from tensor2robot_tpu.utils.metric_writer import MetricWriter
+    writer = MetricWriter(str(tmp_path))
+    server = FleetServer(fleet_policy, max_batch=2, deadline_ms=5.0,
+                         metric_writer=writer)
+    with server:
+      [f.result(timeout=30) for f in
+       [server.submit(tiny_predictor.make_image(i)) for i in range(4)]]
+      server.write_metrics()
+    writer.close()
+    with open(tmp_path / "metrics.jsonl") as f:
+      record = json.loads(f.readlines()[-1])
+    assert "serving/requests" in record
+    assert "serving/latency_p50_ms" in record
+
+  def test_max_batch_cannot_exceed_ladder(self, fleet_policy):
+    from tensor2robot_tpu.serving.server import FleetServer
+    with pytest.raises(ValueError):
+      FleetServer(fleet_policy, max_batch=32)
+
+
+class TestFleetSmokeCLI:
+  """The tier-1 CI lane (ISSUE 1 satellite): `--fleet --smoke` runs the
+  whole serving path chiplessly on every PR and must demonstrate the
+  batching amortization the subsystem exists for."""
+
+  def _run_smoke(self):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, "-m", "tensor2robot_tpu.bin.bench_serving",
+         "--fleet", "--smoke", "--clients", "16", "--frames", "80"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, res.stdout
+    return json.loads(lines[0])
+
+  def test_fleet_smoke_contract_and_amortization(self):
+    obj = self._run_smoke()
+    assert obj["mode"] == "smoke"
+    assert obj["bucket_ladder"] == [1, 2, 4, 8, 16]
+    # Exactly one compiled executable per ladder bucket over the whole
+    # run — warmup, partial deadline flushes, and full batches included.
+    assert obj["compile_counts"] == {str(b): 1 for b in (1, 2, 4, 8, 16)}
+    (point,) = obj["fleet_sweep"]
+    assert point["clients"] == 16
+    # The artifact schema's fleet fields are present and sane.
+    assert point["latency_p50_ms"] > 0
+    assert point["latency_p99_ms"] >= point["latency_p50_ms"]
+    assert 0 < point["batch_occupancy"] <= 1
+    assert obj["single_client_closed_loop_hz"] > 0
+
+    def amortization(o):
+      return (o["fleet_sweep"][0]["aggregate_images_per_sec"]
+              / o["single_client_closed_loop_hz"])
+
+    # Batching amortization: 16 concurrent closed-loop clients clear
+    # >= 3x the single-client closed-loop rate (acceptance bar; the
+    # tiny smoke model makes per-flush dispatch, not conv math, the
+    # dominant cost — the regime batching amortizes). Medians over 3
+    # in-process trials already damp contention; one full re-run is
+    # allowed before declaring the property broken on a shared CI box.
+    ratio = amortization(obj)
+    if ratio < 3.0:
+      retry = self._run_smoke()
+      ratio = max(ratio, amortization(retry))
+    assert ratio >= 3.0, json.dumps(obj, indent=2)

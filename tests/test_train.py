@@ -393,6 +393,30 @@ class TestCheckpoints:
     assert manager2._expects_default_layout(exclude_step=99) is False
     manager2.close()
 
+  def test_probe_not_disarmed_by_midwrite_tmp_dirs(self, tmp_path):
+    """ADVICE r5: a mid-write step dir exposing orbax's tmp item name
+    ('default.orbax-checkpoint-tmp-<ts>') has subdirs but is NOT
+    evidence of a non-default layout — caching False from it would
+    permanently disarm the visibility probe and reopen the restore-
+    poisoning race. A default-prefixed tmp dir confirms the default
+    layout; a foreign-named tmp dir is inconclusive."""
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    step_dir = tmp_path / "ckpt" / "5"
+    os.makedirs(str(step_dir / "default.orbax-checkpoint-tmp-123456"))
+    manager._manager.reload()
+    assert 5 in list(manager.all_steps())
+    # Mid-write default item: evidence FOR the default layout.
+    assert manager._expects_default_layout(exclude_step=99) is True
+    manager.close()
+    # Only a foreign tmp name → inconclusive, probe stays armed (None),
+    # never a learned False.
+    import shutil
+    shutil.rmtree(str(step_dir))
+    os.makedirs(str(step_dir / "state.orbax-checkpoint-tmp-123456"))
+    manager2 = CheckpointManager(str(tmp_path / "ckpt"))
+    assert manager2._expects_default_layout(exclude_step=99) is None
+    manager2.close()
+
   def test_save_interval_and_gc(self, tmp_path):
     manager = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2,
                                 save_interval_steps=10)
