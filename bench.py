@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 5
+ROUND = 6
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -867,6 +867,69 @@ def _bench_input_pipeline(batch_size: int, synthetic_headline_sps: float):
   return out
 
 
+def _bench_serving_compact(trials=3, control_steps=10, image_size=None):
+  """Compact fused-CEM serving measurement for the bench detail.
+
+  VERDICT r5 Weak #4 / Next #3: the serving control rate lived only in
+  bin/bench_serving, which the driver never runs — so a driver-only
+  chip window refreshed throughput but left the serving number stale
+  another round. This measures the single-robot closed loop (CEMPolicy:
+  one fused control step per frame — sample, score, elite-refit — 64
+  samples x 3 iterations) for both wire formats, with the
+  {median,min,max,trials} spread shape every citable field carries.
+  The fleet sweep (micro-batching, bucket ladder, p50/p99) remains
+  bin/bench_serving's job; this block is the driver-path sentinel.
+
+  `image_size` shrinks the model so the chipless orchestrator tests
+  can exercise the block's shape contract on CPU.
+  """
+  from tensor2robot_tpu.predictors.checkpoint_predictor import (
+      CheckpointPredictor)
+  from tensor2robot_tpu.research.qtopt.cem import CEMPolicy
+  from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
+
+  rng = np.random.default_rng(0)
+  out = {}
+  for uint8_images in (False, True):
+    kwargs = {"uint8_images": uint8_images}
+    if image_size:
+      kwargs.update(image_size=image_size, in_image_size=image_size)
+    model = QTOptGraspingModel(**kwargs)
+    predictor = CheckpointPredictor(model)
+    predictor.init_randomly()
+    policy = CEMPolicy(predictor, action_size=4, num_samples=64,
+                       num_elites=6, iterations=3, seed=0)
+    size = model.get_feature_specification("train")["image"].shape[0]
+
+    def make_frame():
+      if uint8_images:
+        return rng.integers(0, 255, (size, size, 3), np.uint8)
+      return rng.random((size, size, 3)).astype(np.float32)
+
+    # Fresh frames per step: the robot loop pays H2D for every camera
+    # image; reusing one frame would hide exactly that cost.
+    frames = [make_frame() for _ in range(control_steps)]
+    jax.block_until_ready(policy(frames[0]))  # compile the control step
+    rates = []
+    for _ in range(max(1, trials)):
+      start = time.perf_counter()
+      for image in frames:
+        jax.block_until_ready(policy(image))
+      rates.append(control_steps / (time.perf_counter() - start))
+    out["uint8" if uint8_images else "float32"] = {
+        "closed_loop_hz": _spread(rates, 1),
+        "closed_loop_ms": _spread([1e3 / r for r in rates], 2),
+        "image_bytes": int(frames[0].nbytes),
+    }
+  out["note"] = (
+      "single-robot fused CEM control step (64 samples x 3 "
+      "iterations), closed loop on fresh frames, both wire formats; "
+      "measured inside bench.py so every driver bench run refreshes "
+      "serving evidence. The fleet micro-batching sweep stays in "
+      "bin/bench_serving --fleet.")
+  return out
+
+
 def main() -> None:
   from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
 
@@ -975,6 +1038,11 @@ def main() -> None:
   except Exception as e:
     input_pipeline = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    serving = _bench_serving_compact()
+  except Exception as e:
+    serving = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1028,6 +1096,7 @@ def main() -> None:
       "conv_microbench": microbench,
       "variants": variants,
       "input_pipeline": input_pipeline,
+      "serving": serving,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:

@@ -1,0 +1,135 @@
+#!/usr/bin/env bash
+# Chip watcher: turn ANY TPU-pool window into the round's committed
+# artifacts (VERDICT r5 Weak #1 / Next #1: the r5 watcher lived in /tmp
+# and died with the container — this is the committed, durable form).
+#
+# Usage:  scripts/measure_round.sh [ROUND]        # default: bench.py's ROUND
+#         nohup scripts/measure_round.sh >/dev/null 2>&1 &   # arm for the session
+#
+# Behavior:
+#   - Polls the pool with a BOUNDED probe (timeout'd subprocess import of
+#     jax; a CPU backend is rejected, mirroring bench.py's cpu_fallback
+#     guard) every POLL_S seconds, up to MAX_HOURS.
+#   - When a chip appears, runs the measurement stages in order. Each
+#     stage is SKIPPED when its artifact already exists and is non-empty,
+#     so a watcher restarted mid-round (or racing the driver) never
+#     clobbers landed evidence and resumes where it left off.
+#   - Every stage is bounded by its own timeout; a stage failure logs and
+#     moves on (a flapping pool should not forfeit the other stages).
+#   - Logs to the STABLE path /tmp/measure_round.log (append, stamped
+#     with round + UTC time) so any session can `tail` the same file.
+#
+# Stages (artifact -> producer):
+#   BENCH_DETAIL_r0N.json        bench.py (orchestrator; also emits the
+#                                compact line, saved to BENCH_builder_r0N.json)
+#   SERVING_r0N.json             bin/bench_serving single-robot + --fleet lines
+#   CAPABILITY_r0N_fast.jsonl    bin/run_capability_checks --scale fast
+#                                (+ vrgripper seed-offsets 1,2 for spread —
+#                                VERDICT r5 #5)
+#   CAPABILITY_r0N_full.jsonl    bin/run_capability_checks --scale full
+#   TPU_TESTS_r0N.log            pytest tests/ --tpu (the on-chip lane)
+#
+# After a successful sweep, flip the matching docs/ARTIFACTS.md rows to
+# `committed` and commit the artifacts (the round-start orphan sweep
+# catches any the session forgot).
+
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+ROUND="${1:-$(sed -n 's/^ROUND = \([0-9]\+\)$/\1/p' bench.py)}"
+RTAG=$(printf 'r%02d' "$ROUND")
+LOG="${MEASURE_LOG:-/tmp/measure_round.log}"
+POLL_S="${MEASURE_POLL_S:-600}"
+PROBE_TIMEOUT_S="${MEASURE_PROBE_TIMEOUT_S:-150}"
+MAX_HOURS="${MEASURE_MAX_HOURS:-12}"
+
+log() { echo "[$(date -u +%FT%TZ) $RTAG] $*" >>"$LOG"; }
+
+probe_chip() {
+  # Bounded probe; a silent no-free-chip claim hangs and is killed.
+  kind=$(timeout -k 5 "$PROBE_TIMEOUT_S" python -c \
+    'import jax; print(jax.devices()[0].device_kind)' 2>/dev/null \
+    | tail -n 1)
+  [ -n "$kind" ] && [ "$(echo "$kind" | tr '[:upper:]' '[:lower:]')" != cpu ]
+}
+
+run_stage() {
+  # run_stage <artifact> <timeout_s> <cmd...>: skip if landed, bound, log.
+  # The command must write to $STAGE_TMP; it is moved onto the artifact
+  # only on success, so a mid-stage failure/timeout can never leave a
+  # truncated or partial artifact that a restarted watcher would treat
+  # as landed and skip forever.
+  artifact="$1"; bound="$2"; shift 2
+  if [ -s "$artifact" ]; then
+    log "skip $artifact (exists)"
+    return 0
+  fi
+  STAGE_TMP="${artifact}.tmp"
+  export STAGE_TMP
+  rm -f "$STAGE_TMP"
+  log "start $artifact: $*"
+  if timeout -k 30 "$bound" "$@" >>"$LOG" 2>&1 && [ -s "$STAGE_TMP" ]; then
+    mv "$STAGE_TMP" "$artifact"
+    log "done $artifact"
+  else
+    rc=$?
+    rm -f "$STAGE_TMP"
+    log "FAILED $artifact (rc=$rc) — continuing with remaining stages"
+    return 1
+  fi
+}
+
+log "watcher armed (poll ${POLL_S}s, probe bound ${PROBE_TIMEOUT_S}s, max ${MAX_HOURS}h)"
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  # Never perturb a live test run: the probe's jax import is real CPU
+  # on a small host, and the serving smoke's amortization bar is a
+  # TIMING assert — a probe landing mid-suite is exactly the kind of
+  # contention that flakes it (observed r6). Defer until pytest exits.
+  if pgrep -f "python -m pytest" >/dev/null 2>&1; then
+    log "deferring probe: pytest is running"
+    sleep 60
+    continue
+  fi
+  if probe_chip; then
+    log "chip available — starting measurement sweep"
+    # bench.py orchestrates its own probe/retry and writes the detail
+    # file itself; its compact contract line is the staged artifact
+    # here (the detail file lands beside it from the same run). A
+    # structured OUTAGE line (rc 0 by design) must NOT land as the
+    # stage artifact — that would mark the stage done and skip every
+    # later chip window — so the stage only succeeds on a real
+    # measurement (non-null value, no error key).
+    run_stage "BENCH_builder_${RTAG}.json" 3600 sh -c '
+      python bench.py > "$STAGE_TMP" &&
+      python - "$STAGE_TMP" <<PYEOF
+import json, sys
+obj = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+sys.exit(1 if obj.get("error") or obj.get("value") is None else 0)
+PYEOF'
+    run_stage "SERVING_${RTAG}.json" 1800 sh -c '
+      python -m tensor2robot_tpu.bin.bench_serving >  "$STAGE_TMP" &&
+      python -m tensor2robot_tpu.bin.bench_serving --fleet >> "$STAGE_TMP"'
+    run_stage "CAPABILITY_${RTAG}_fast.jsonl" 5400 sh -c '
+      python -m tensor2robot_tpu.bin.run_capability_checks --scale fast \
+        > "$STAGE_TMP" &&
+      for off in 1 2; do
+        python -m tensor2robot_tpu.bin.run_capability_checks --scale fast \
+          --checks vrgripper --seed-offset $off >> "$STAGE_TMP" || exit 1;
+      done'
+    run_stage "CAPABILITY_${RTAG}_full.jsonl" 10800 \
+      sh -c 'python -m tensor2robot_tpu.bin.run_capability_checks --scale full \
+        > "$STAGE_TMP"'
+    # Test failures still produce the (valuable) log — only a hang/kill
+    # discards the partial tmp and leaves the stage retryable.
+    run_stage "TPU_TESTS_${RTAG}.log" 3600 \
+      sh -c 'python -m pytest tests/ --tpu -q > "$STAGE_TMP" 2>&1; true'
+    log "sweep complete — flip docs/ARTIFACTS.md rows to committed and commit"
+    exit 0
+  fi
+  log "pool unavailable; sleeping ${POLL_S}s"
+  sleep "$POLL_S"
+done
+log "watcher expired after ${MAX_HOURS}h with no chip window"
+exit 1

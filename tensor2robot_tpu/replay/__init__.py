@@ -1,0 +1,50 @@
+"""Distributed replay + Bellman updater: the closed QT-Opt learning loop.
+
+The reference repo shipped only the Q-function; its collectors, replay
+log buffer, and Bellman updater fleet ran off-repo (SURVEY.md §2). This
+package reconstructs that loop in the Podracer shape (PAPERS.md,
+arXiv:2104.06272) — fixed-shape batches, a bounded compiled-program
+set, host-RAM replay:
+
+- ``ReplayBuffer`` / ``ShardedReplayBuffer`` (ring_buffer.py):
+  preallocated spec-validated ring storage, O(1) wraparound append,
+  seeded uniform or TD-proportional (sum-tree) sampling at ONE fixed
+  batch shape;
+- ``SumTree`` (sum_tree.py): O(log n) proportional sampling;
+- ``episode_to_transitions`` / ``TransitionQueue`` / ``ReplayFeeder``
+  (ingest.py): episode flattening, bounded drop-oldest backpressure
+  with counted sheds, min-fill gating;
+- ``BellmanUpdater`` (bellman.py): lagged/polyak target network,
+  CEM-maximized Q-targets (reward + gamma * max_a' Q_target), AOT at
+  the fixed batch shape with a compile-count ledger;
+- ``ReplayTrainLoop`` (loop.py): async collect -> replay -> train
+  driver wiring serving's CEMFleetPolicy collectors, the buffer, the
+  updater, and train/trainer.py together, with replay-health metrics
+  through utils/metric_writer.
+
+Entry point: ``python -m tensor2robot_tpu.bin.run_qtopt_replay``.
+"""
+
+from tensor2robot_tpu.replay.bellman import BellmanUpdater
+from tensor2robot_tpu.replay.ingest import (ReplayFeeder, TransitionQueue,
+                                            episode_to_transitions)
+from tensor2robot_tpu.replay.loop import (CollectorWorker, ReplayLoopConfig,
+                                          ReplayTrainLoop, transition_spec)
+from tensor2robot_tpu.replay.ring_buffer import (ReplayBuffer, SampleInfo,
+                                                 ShardedReplayBuffer)
+from tensor2robot_tpu.replay.sum_tree import SumTree
+
+__all__ = [
+    "BellmanUpdater",
+    "CollectorWorker",
+    "ReplayBuffer",
+    "ReplayFeeder",
+    "ReplayLoopConfig",
+    "ReplayTrainLoop",
+    "SampleInfo",
+    "ShardedReplayBuffer",
+    "SumTree",
+    "TransitionQueue",
+    "episode_to_transitions",
+    "transition_spec",
+]

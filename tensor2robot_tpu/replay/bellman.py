@@ -1,0 +1,214 @@
+"""BellmanUpdater: CEM-maximized Q-targets against a lagged target net.
+
+The defining QT-Opt computation (PAPER.md / SURVEY.md §2): the Bellman
+updater fleet consumed sampled transitions and produced training
+targets
+
+    target(s, a) = r + gamma * (1 - done) * max_a' Q_target(s', a')
+
+where the max is the SAME cross-entropy-method search serving uses —
+QT-Opt's whole trick is that argmax-free Q-learning over continuous
+actions reuses one CEM routine at collect, label, and serve time. Here
+the max runs through `cem.fleet_cem_optimize` (the serving-grade
+variant with caller-supplied per-state keys), so label randomness is a
+pure function of (transition position, seed), independent of batch
+composition — the same determinism contract the fleet server holds.
+
+TPU-native shape discipline (Podracer, arXiv:2104.06272): the target
+computation is AOT-compiled ONCE at the replay buffer's fixed batch
+shape. The target network is a pytree ARGUMENT of that executable, not
+a captured constant — refresh (hard lag or polyak) swaps arrays, never
+recompiles — and `compile_counts` is the ledger tests assert stays at
+exactly one executable per function for the life of the updater.
+
+The reference used a hard lagged target (push params every N steps to
+the updater fleet); polyak averaging is the small generalization most
+later off-policy systems settled on, so both are offered: pass
+`polyak_tau` for soft updates, leave it None for hard copies.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.research.qtopt import cem
+
+
+class BellmanUpdater:
+  """Q-target labeller over a critic model with a ``q_predicted`` head."""
+
+  def __init__(
+      self,
+      model,
+      variables,
+      action_size: int = 4,
+      gamma: float = 0.9,
+      num_samples: int = 32,
+      num_elites: int = 4,
+      iterations: int = 2,
+      seed: int = 0,
+      polyak_tau: Optional[float] = None,
+  ):
+    """Args:
+      model: a CriticModel (loss_type decides target value space: the
+        cross-entropy head clips targets to [0, 1], the published
+        QT-Opt grasping formulation; mse leaves them unclipped).
+      variables: initial online variables; the target net starts as a
+        copy (a random target bootstraps garbage, but min-fill gating
+        plus the first refresh bound how long that lasts — same as the
+        reference's cold-start).
+      action_size / num_samples / num_elites / iterations: the CEM
+        search budget for the max (the reference used the serving
+        config here too).
+      polyak_tau: None = hard copy on refresh(); else
+        target <- tau * online + (1 - tau) * target per refresh call.
+    """
+    self._model = model
+    self._action_size = action_size
+    self._gamma = gamma
+    self._num_samples = num_samples
+    self._num_elites = num_elites
+    self._iterations = iterations
+    self._seed = seed
+    self._polyak_tau = polyak_tau
+    self._clip_targets = getattr(model, "loss_type",
+                                 "cross_entropy") == "cross_entropy"
+    self._target_variables = jax.tree_util.tree_map(jnp.copy, variables)
+    self._refresh_count = 0
+    self.last_refresh_step = 0
+    # fn name -> number of XLA compiles; the replay smoke asserts every
+    # value is exactly 1 (fixed-shape sampling never recompiles).
+    self.compile_counts: Dict[str, int] = {}
+    self._targets_exec = None
+    self._td_exec = None
+    self._next_label_seed = 0
+
+  # --- target network ------------------------------------------------------
+
+  def refresh(self, variables, step: int) -> None:
+    """Pulls the online variables into the target net (lag or polyak)."""
+    if self._polyak_tau is None:
+      self._target_variables = jax.tree_util.tree_map(jnp.copy, variables)
+    else:
+      tau = self._polyak_tau
+      self._target_variables = jax.tree_util.tree_map(
+          lambda online, target: tau * online + (1.0 - tau) * target,
+          variables, self._target_variables)
+    self._refresh_count += 1
+    self.last_refresh_step = int(step)
+
+  def target_lag(self, step: int) -> int:
+    """Optimizer steps since the target net last saw online params."""
+    return int(step) - self.last_refresh_step
+
+  @property
+  def refresh_count(self) -> int:
+    return self._refresh_count
+
+  # --- compiled computations ----------------------------------------------
+
+  def _q_value(self, logits: jnp.ndarray) -> jnp.ndarray:
+    """Logit → value space (mirrors CriticModel.q_value on arrays)."""
+    logits = logits.astype(jnp.float32)
+    return jax.nn.sigmoid(logits) if self._clip_targets else logits
+
+  def _build_targets_fn(self):
+    model, action_size = self._model, self._action_size
+    gamma, seed = self._gamma, self._seed
+    num_samples, num_elites = self._num_samples, self._num_elites
+    iterations, clip = self._iterations, self._clip_targets
+
+    def targets_fn(target_variables, next_images, rewards, dones, seeds):
+      base = jax.random.key(seed)
+      keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(seeds)
+
+      # The SAME tiled score the fleet serving policy uses: actions
+      # served and actions that label targets go through one contract.
+      score = cem.make_tiled_q_score_fn(model.predict_fn,
+                                        target_variables)
+
+      _, best_logits = cem.fleet_cem_optimize(
+          score, next_images, keys, action_size,
+          num_samples=num_samples, num_elites=num_elites,
+          iterations=iterations)
+      q_next = self._q_value(best_logits)
+      targets = (rewards.astype(jnp.float32)
+                 + gamma * (1.0 - dones.astype(jnp.float32)) * q_next)
+      if clip:
+        targets = jnp.clip(targets, 0.0, 1.0)
+      return targets, q_next
+
+    return targets_fn
+
+  def _build_td_fn(self):
+    model = self._model
+
+    def td_fn(variables, images, actions, targets):
+      outputs = model.predict_fn(
+          variables,
+          {"image": images, "action": actions.astype(jnp.float32)})
+      q = self._q_value(jnp.reshape(outputs["q_predicted"], (-1,)))
+      return jnp.abs(q - targets.astype(jnp.float32))
+
+    return td_fn
+
+  def _compile(self, name: str, fn, args):
+    """AOT lower+compile at the args' (fixed) shapes, ledger bumped.
+
+    AOT executables REJECT any later shape drift instead of silently
+    recompiling — the ledger plus this hard failure is what makes
+    "compiles exactly once" an enforced property, not a hope.
+    """
+    executable = jax.jit(fn).lower(*args).compile()
+    self.compile_counts[name] = self.compile_counts.get(name, 0) + 1
+    return executable
+
+  def compute_targets(
+      self, batch, seeds: Optional[np.ndarray] = None
+  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Labels one fixed-shape transition batch.
+
+    Args:
+      batch: mapping with next_image / reward / done leaves (the
+        ReplayBuffer's sampled batch).
+      seeds: (B,) uint32 CEM label seeds; default: a monotonic counter
+        so every label draw in the run is distinct but replayable.
+
+    Returns:
+      (targets (B,), q_next (B,)) as host numpy.
+    """
+    next_images = jnp.asarray(batch["next_image"])
+    rewards = jnp.asarray(batch["reward"])
+    dones = jnp.asarray(batch["done"])
+    n = next_images.shape[0]
+    if seeds is None:
+      seeds = np.arange(self._next_label_seed,
+                        self._next_label_seed + n, dtype=np.uint32)
+      self._next_label_seed += n
+    seeds = jnp.asarray(seeds, jnp.uint32)
+    args = (self._target_variables, next_images, rewards, dones, seeds)
+    if self._targets_exec is None:
+      self._targets_exec = self._compile(
+          "bellman_targets", self._build_targets_fn(), args)
+    targets, q_next = self._targets_exec(*args)
+    return np.asarray(targets), np.asarray(q_next)
+
+  def td_errors(self, variables, batch,
+                targets: np.ndarray) -> np.ndarray:
+    """|Q(s, a) - target| per transition, in value space.
+
+    Drives BOTH prioritized-replay updates (sampled batch, online
+    params) and the loop's eval metric (held-out batch). One tiny
+    forward, compiled once at the fixed batch shape.
+    """
+    images = jnp.asarray(batch["image"])
+    actions = jnp.asarray(batch["action"])
+    targets = jnp.asarray(targets)
+    args = (variables, images, actions, targets)
+    if self._td_exec is None:
+      self._td_exec = self._compile("td_error", self._build_td_fn(), args)
+    return np.asarray(self._td_exec(*args))

@@ -1,0 +1,180 @@
+"""Episode → transition ingestion with backpressure and min-fill gating.
+
+The host-side path between collectors and the ReplayBuffer, following
+`research/vrgripper/episode_to_transitions.py` conventions (stream-
+length validation with named counts, per-timestep flattening) but
+emitting in-memory transition batches instead of tf.Examples — the
+replay loop's wire is numpy, not records.
+
+Backpressure design (Podracer actor/learner split, PAPERS.md): the
+collector threads and the train thread run at independent rates, so the
+hand-off is a BOUNDED queue with a drop-OLDEST policy — when training
+stalls (compiles, checkpoints), collectors keep running and the queue
+sheds the stalest experience first, which is exactly the experience a
+fresher policy has already outgrown. Every shed transition is counted:
+drop_rate is a first-class loop metric, because silent shedding looks
+identical to a healthy loop until the learning curve flattens.
+
+Min-fill gating: training before the buffer holds a minimum diversity
+of experience overfits the first few episodes and poisons the priority
+distribution; `ReplayFeeder.ready()` gates the first train step on a
+configured fill (the reference's replay log did the same by only
+spinning up Bellman updaters against a warm log).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.replay.ring_buffer import ReplayBuffer
+
+# The loop's canonical transition keys (single-step Bellman form).
+TRANSITION_KEYS = ("image", "action", "reward", "done", "next_image")
+
+
+def episode_to_transitions(
+    episode: Mapping[str, np.ndarray]) -> List[Dict[str, np.ndarray]]:
+  """One episode dict → per-step transition dicts.
+
+  Args:
+    episode: {"images": (T+1, H, W, C) observations s_0..s_T,
+      "actions": (T, A), "rewards": (T,), "dones": (T,)}. The final
+      observation closes the last transition's next_image, mirroring
+      the reference's episode_to_transitions stream layout (which
+      carried T-aligned streams; the +1 here is the Bellman next-state
+      the supervised BC pipeline never needed).
+
+  Returns:
+    T dicts keyed by TRANSITION_KEYS.
+  """
+  images = np.asarray(episode["images"])
+  actions = np.asarray(episode["actions"])
+  rewards = np.asarray(episode["rewards"], np.float32)
+  dones = np.asarray(episode["dones"], np.float32)
+  t = len(actions)
+  if not (len(images) == t + 1 and len(rewards) == t and len(dones) == t):
+    raise ValueError(
+        f"Episode streams disagree on length: images={len(images)} "
+        f"(need T+1) actions={len(actions)} rewards={len(rewards)} "
+        f"dones={len(dones)}")
+  return [{
+      "image": images[i],
+      "action": actions[i],
+      "reward": rewards[i],
+      "done": dones[i],
+      "next_image": images[i + 1],
+  } for i in range(t)]
+
+
+class TransitionQueue:
+  """Bounded thread-safe transition queue, drop-oldest on overflow.
+
+  Counters (all monotonic, read via stats()):
+    enqueued: transitions accepted from collectors.
+    dropped:  transitions shed by the drop-oldest policy.
+    dequeued: transitions drained toward the buffer.
+  """
+
+  def __init__(self, capacity: int):
+    if capacity < 1:
+      raise ValueError(f"capacity must be >= 1, got {capacity}")
+    self.capacity = capacity
+    self._items: Deque[Dict[str, np.ndarray]] = deque()
+    self._lock = threading.Lock()
+    self.enqueued = 0
+    self.dropped = 0
+    self.dequeued = 0
+
+  def put_episode(self, episode: Mapping[str, np.ndarray]) -> int:
+    """Flattens an episode and enqueues its transitions; returns count."""
+    transitions = episode_to_transitions(episode)
+    with self._lock:
+      for transition in transitions:
+        if len(self._items) >= self.capacity:
+          self._items.popleft()
+          self.dropped += 1
+        self._items.append(transition)
+        self.enqueued += 1
+    return len(transitions)
+
+  def put(self, transition: Dict[str, np.ndarray]) -> None:
+    """Enqueues one transition (drop-oldest when full)."""
+    with self._lock:
+      if len(self._items) >= self.capacity:
+        self._items.popleft()
+        self.dropped += 1
+      self._items.append(transition)
+      self.enqueued += 1
+
+  def drain(self, max_items: Optional[int] = None
+            ) -> List[Dict[str, np.ndarray]]:
+    """Pops up to max_items (default: all) in FIFO order."""
+    with self._lock:
+      n = len(self._items) if max_items is None else min(
+          max_items, len(self._items))
+      out = [self._items.popleft() for _ in range(n)]
+      self.dequeued += n
+    return out
+
+  def __len__(self) -> int:
+    with self._lock:
+      return len(self._items)
+
+  def stats(self) -> Dict[str, int]:
+    with self._lock:
+      return {
+          "enqueued": self.enqueued,
+          "dropped": self.dropped,
+          "dequeued": self.dequeued,
+          "pending": len(self._items),
+      }
+
+
+class ReplayFeeder:
+  """Queue → buffer pump with min-fill gating.
+
+  The train loop calls `drain()` once per step (cheap when empty) and
+  gates its first optimizer step on `ready()`. Validation happens at
+  the buffer door, so a malformed collector payload surfaces here with
+  a spec key, not inside compiled code.
+  """
+
+  def __init__(self, queue: TransitionQueue, buffer: ReplayBuffer,
+               min_fill: int):
+    if min_fill < 1:
+      raise ValueError(f"min_fill must be >= 1, got {min_fill}")
+    if min_fill > buffer.capacity:
+      raise ValueError(
+          f"min_fill {min_fill} exceeds buffer capacity "
+          f"{buffer.capacity}: the gate would never open")
+    self.queue = queue
+    self.buffer = buffer
+    self.min_fill = min_fill
+
+  def drain(self) -> int:
+    """Moves every pending transition into the buffer; returns count."""
+    transitions = self.queue.drain()
+    for transition in transitions:
+      self.buffer.append(transition)
+    return len(transitions)
+
+  def ready(self) -> bool:
+    """True once the buffer holds min_fill transitions (latching —
+    the ring never shrinks, so once open the gate stays open)."""
+    return self.buffer.size >= self.min_fill
+
+  def metrics(self) -> Dict[str, float]:
+    """Feeder/queue health block (metric_writer-ready)."""
+    stats = self.queue.stats()
+    enqueued = max(stats["enqueued"], 1)
+    return {
+        "replay/ingest_enqueued": float(stats["enqueued"]),
+        "replay/ingest_dropped": float(stats["dropped"]),
+        "replay/ingest_pending": float(stats["pending"]),
+        "replay/drop_rate": stats["dropped"] / enqueued,
+        "replay/min_fill_ready": float(self.ready()),
+    }

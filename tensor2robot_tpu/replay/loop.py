@@ -1,0 +1,545 @@
+"""The closed QT-Opt loop: collect → replay → Bellman-label → train.
+
+This is the subsystem the reference repo never contained (SURVEY.md §2:
+only the Q-function model is in-tree; the collector fleet, replay log,
+and Bellman updaters ran off-repo) — rebuilt in the Podracer shape
+(PAPERS.md, arXiv:2104.06272): actors and learner in one process
+sharing host RAM, fixed-shape device-resident batches, and a bounded
+set of compiled programs whose count is ASSERTED, not hoped for.
+
+Data path per optimizer step:
+
+  collectors (threads)            train thread
+  ─────────────────────           ───────────────────────────────
+  CEMFleetPolicy over a           feeder.drain() → ReplayBuffer
+  fleet of GraspRetryEnvs         buffer.sample()      (fixed shape)
+  → episodes → TransitionQueue    BellmanUpdater.compute_targets
+     (bounded, drop-oldest)       trainer AOT train_step (donated)
+                                  td_errors → priorities + metrics
+                                  every K: push params to collectors
+                                           + refresh target net
+
+Compiled-program ledger (`compile_counts` in the result): ONE train-step
+executable, ONE Bellman-target executable, ONE TD executable, ONE eval
+executable, ONE CEM executable per collector bucket — everything AOT at
+the buffer's fixed batch shape, so a shape regression raises instead of
+silently recompiling (the recompile is the TPU production killer: a
+30-second XLA compile mid-loop starves every collector).
+
+Param refresh rides the predictors' hot-reload contract: collectors
+hold a `_HotReloadPredictor` whose variables the train thread swaps —
+the CEM executables are keyed on bucket size only (serving/policy.py),
+so a refresh never recompiles, exactly like the fleet server's
+checkpoint hot-reload.
+
+Metrics flow through utils/metric_writer (fill fraction, sample
+staleness, ingest drop rate, priority entropy, target-network lag,
+train/eval TD) — the replay-health block a production loop pages on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+import optax
+
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.replay.bellman import BellmanUpdater
+from tensor2robot_tpu.replay.ingest import ReplayFeeder, TransitionQueue
+from tensor2robot_tpu.replay.ring_buffer import (ReplayBuffer,
+                                                 ShardedReplayBuffer)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+def transition_spec(image_size: int, action_size: int) -> ts.TensorSpecStruct:
+  """The loop's transition schema (uint8 wire images, Bellman leaves)."""
+  image = ts.ExtendedTensorSpec((image_size, image_size, 3), np.uint8,
+                                name="image")
+  return ts.TensorSpecStruct({
+      "image": image,
+      "action": ts.ExtendedTensorSpec((action_size,), np.float32,
+                                      name="action"),
+      "reward": ts.ExtendedTensorSpec((), np.float32, name="reward"),
+      "done": ts.ExtendedTensorSpec((), np.float32, name="done"),
+      "next_image": ts.ExtendedTensorSpec.from_spec(image,
+                                                    name="next_image"),
+  })
+
+
+class _HotReloadPredictor(AbstractPredictor):
+  """In-memory predictor whose variables the train thread hot-swaps.
+
+  The minimal form of the checkpoint/export predictors' hot-reload
+  contract: `device_fn()` returns a STABLE fn (the model's predict_fn —
+  so jit caches and AOT executables survive updates) plus whatever
+  variables are current; `update()` is an atomic pointer swap (GIL) and
+  bumps model_version like a new export landing.
+  """
+
+  def __init__(self, model, variables):
+    import jax
+    self._model = model
+    self._variables = variables
+    self._version = 0
+    self._jitted = jax.jit(model.predict_fn)
+
+  def update(self, variables) -> None:
+    self._variables = variables
+    self._version += 1
+
+  def restore(self, timeout_s: float = 0.0) -> bool:
+    return True
+
+  def init_randomly(self) -> None:
+    pass
+
+  def predict(self, features):
+    outputs = self._jitted(self._variables, dict(features))
+    return {k: np.asarray(v) for k, v in outputs.items()}
+
+  def device_fn(self):
+    return self._model.predict_fn, self._variables
+
+  def get_feature_specification(self) -> ts.TensorSpecStruct:
+    return ts.flatten_spec_structure(
+        self._model.get_feature_specification("predict"))
+
+  @property
+  def model_version(self) -> int:
+    return self._version
+
+
+class CollectorWorker:
+  """One thread driving a fleet of GraspRetryEnvs through a CEM policy.
+
+  All `num_envs` envs step in LOCKSTEP through one batched policy call,
+  so the policy compiles exactly one bucket executable; an env that
+  finishes its episode flushes it to the queue and resets immediately,
+  keeping the batch shape constant forever.
+  """
+
+  def __init__(self, policy, queue: TransitionQueue, image_size: int,
+               num_envs: int = 4, max_attempts: int = 4,
+               seed: int = 0, grasp_radius: float = 0.35,
+               exploration_epsilon: float = 0.2,
+               scripted_fraction: float = 0.25):
+    from tensor2robot_tpu.research.qtopt.synthetic_grasping import (
+        GraspRetryEnv)
+    self._policy = policy
+    self._queue = queue
+    # Exploration mix, QT-Opt parity: the reference's logs were seeded
+    # by SCRIPTED grasps (its real-robot data was majority scripted
+    # early on — synthetic_grasping.generate_grasps models the same
+    # with positive_fraction) plus noisy on-policy actions. A cold
+    # random Q CANNOT be the only success source: with rare positives
+    # the critic fits the base rate (a constant) and the CEM max never
+    # rises, so the loop needs scripted successes exactly like the
+    # reference did. epsilon draws uniform actions; scripted_fraction
+    # draws near-object actions from the env's oracle pose.
+    self._epsilon = exploration_epsilon
+    self._scripted = scripted_fraction
+    self._explore_rng = np.random.default_rng(seed + 555)
+    self._envs = [
+        GraspRetryEnv(image_size=image_size, max_attempts=max_attempts,
+                      radius=grasp_radius)
+        for _ in range(num_envs)
+    ]
+    self._seed = seed
+    self._next_scene = 0
+    self._records: List[Dict[str, list]] = [
+        {"actions": [], "rewards": [], "dones": []}
+        for _ in range(num_envs)
+    ]
+    self.episodes = 0
+    self.successes = 0
+    self.errors: List[BaseException] = []
+    self._stop = threading.Event()
+    self._thread = threading.Thread(target=self._run, daemon=True)
+
+  def start(self) -> None:
+    for env in self._envs:
+      env.reset(self._scene_seed())
+    self._thread.start()
+
+  def request_stop(self) -> None:
+    """Signals the thread; returns immediately (never raises)."""
+    self._stop.set()
+
+  def stop(self, timeout: float = 30.0) -> None:
+    """Signal + join + surface any recorded error. A multi-collector
+    owner should request_stop() on EVERY worker first, then join —
+    one dead collector must not leave its siblings running."""
+    self.request_stop()
+    self._thread.join(timeout)
+    if self.errors:
+      raise RuntimeError("collector died") from self.errors[0]
+
+  def _scene_seed(self) -> int:
+    seed = self._seed * 1_000_003 + self._next_scene
+    self._next_scene += 1
+    return seed
+
+  def _run(self) -> None:
+    try:
+      while not self._stop.is_set():
+        self.step_once()
+    except BaseException as e:  # noqa: BLE001 — surfaced via stop()
+      self.errors.append(e)
+
+  def step_once(self) -> None:
+    """One lockstep control step across the whole env fleet."""
+    images = [env.image for env in self._envs]
+    actions = np.asarray(self._policy(images))
+    draw = self._explore_rng.random(len(self._envs))
+    uniform = self._explore_rng.uniform(
+        -1.0, 1.0, actions.shape).astype(np.float32)
+    scripted = uniform.copy()
+    noise = self._explore_rng.normal(
+        0.0, 0.12, (len(self._envs), 2)).astype(np.float32)
+    scripted[:, :2] = np.clip(
+        np.stack([env.target for env in self._envs]) + noise, -1.0, 1.0)
+    actions = np.where((draw < self._epsilon)[:, None], uniform, actions)
+    actions = np.where(
+        (draw >= 1.0 - self._scripted)[:, None], scripted, actions)
+    for env, record, action in zip(self._envs, self._records, actions):
+      scene = env.image
+      reward, done, truncated = env.step(np.asarray(action))
+      record["actions"].append(np.asarray(action, np.float32))
+      record["rewards"].append(reward)
+      # Bootstrap through truncation: only SUCCESS terminates value.
+      record["dones"].append(float(done))
+      if done or truncated:
+        t = len(record["actions"])
+        self._queue.put_episode({
+            # Static scene: every observation in the episode (including
+            # the closing next-state) is the same rendered image.
+            "images": np.stack([scene] * (t + 1)),
+            "actions": np.stack(record["actions"]),
+            "rewards": np.asarray(record["rewards"], np.float32),
+            "dones": np.asarray(record["dones"], np.float32),
+        })
+        self.episodes += 1
+        self.successes += int(done)
+        record["actions"], record["rewards"], record["dones"] = [], [], []
+        env.reset(self._scene_seed())
+
+
+@dataclass
+class ReplayLoopConfig:
+  """Knobs for ReplayTrainLoop (defaults: the chipless CI smoke scale)."""
+  image_size: int = 16
+  action_size: int = 4
+  batch_size: int = 32
+  capacity: int = 512
+  min_fill: int = 96
+  num_buffer_shards: int = 2
+  prioritized: bool = True
+  gamma: float = 0.8
+  learning_rate: float = 3e-3
+  num_collectors: int = 1
+  envs_per_collector: int = 4
+  max_attempts: int = 3
+  grasp_radius: float = 0.4
+  queue_capacity: int = 512
+  cem_num_samples: int = 16
+  cem_num_elites: int = 4
+  cem_iterations: int = 2
+  exploration_epsilon: float = 0.25
+  scripted_fraction: float = 0.25
+  refresh_every: int = 15
+  polyak_tau: Optional[float] = None  # None = hard target copy
+  eval_every: int = 30
+  eval_batches: int = 4
+  log_every: int = 10
+  seed: int = 0
+  min_fill_timeout_s: float = 300.0
+  model_kwargs: Dict = field(default_factory=dict)
+
+
+class ReplayTrainLoop:
+  """Owns every piece of the loop; `run(num_steps)` drives it.
+
+  Args:
+    model: any CriticModel with uint8 image + action features (must
+      match `config.image_size`/`action_size`). Default: the flagship
+      QTOptGraspingModel on the uint8 wire — the production loop. The
+      CI smoke passes replay/smoke.TinyQCriticModel instead (see its
+      docstring for why the flagship cannot witness learning at CI
+      budgets).
+  """
+
+  def __init__(self, config: ReplayLoopConfig, logdir: str, model=None):
+    from tensor2robot_tpu.train.trainer import Trainer
+    from tensor2robot_tpu.utils.metric_writer import MetricWriter
+
+    self.config = config
+    self.logdir = logdir
+    self.model = model if model is not None else self._default_model()
+    self.trainer = Trainer(self.model, seed=config.seed)
+    self.writer = MetricWriter(logdir)
+    spec = transition_spec(config.image_size, config.action_size)
+    if config.num_buffer_shards > 1:
+      self.buffer = ShardedReplayBuffer(
+          spec, config.capacity, config.batch_size,
+          num_shards=config.num_buffer_shards, seed=config.seed,
+          prioritized=config.prioritized)
+    else:
+      self.buffer = ReplayBuffer(
+          spec, config.capacity, config.batch_size, seed=config.seed,
+          prioritized=config.prioritized)
+    self.queue = TransitionQueue(config.queue_capacity)
+    self.feeder = ReplayFeeder(self.queue, self.buffer, config.min_fill)
+    self.compile_counts: Dict[str, int] = {}
+    self._collectors: List[CollectorWorker] = []
+
+  # --- helpers -------------------------------------------------------------
+
+  def _default_model(self):
+    """The production model: flagship Q-fn, uint8 wire, GroupNorm.
+
+    GroupNorm instead of reference BatchNorm because the loop serves
+    PREDICT-mode params continuously from step 0, and BN's cold running
+    statistics would poison every early Q-target in a way that
+    self-heals too slowly for a continuous loop's warm-up."""
+    from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
+    config = self.config
+    return QTOptGraspingModel(
+        image_size=config.image_size, action_size=config.action_size,
+        uint8_images=True, norm="group",
+        optimizer_fn=lambda: optax.adam(config.learning_rate),
+        **config.model_kwargs)
+
+  def _host_variables(self, state):
+    from tensor2robot_tpu.export import export_utils
+    return export_utils.fetch_variables_to_host(
+        state.variables(use_ema=True))
+
+  def _make_policy(self, predictor):
+    from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+    c = self.config
+    return CEMFleetPolicy(
+        predictor, action_size=c.action_size,
+        num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
+        iterations=c.cem_iterations, seed=c.seed + 7)
+
+  def _eval_transitions(self):
+    """Held-out random-action eval set WITH its analytic value targets.
+
+    The retry env has a closed-form optimal Q (synthetic_grasping.
+    GraspRetryEnv docstring): grasping at the object always succeeds,
+    so V*(s) = 1 and
+
+        Q*(s, a) = 1 if success(a) else gamma.
+
+    Eval TD-error is measured against THIS fixed point, not the moving
+    target network: the Bellman residual of a random init is near zero
+    by self-consistency (q ≈ gamma·q everywhere), so it cannot witness
+    learning — distance to Q* starts large and falls only if the
+    updater actually propagates grasp reward through the CEM max.
+
+    Returns (batches, q_star_per_batch).
+    """
+    from tensor2robot_tpu.research.qtopt import synthetic_grasping as sg
+    c = self.config
+    n = c.batch_size * c.eval_batches
+    images, targets = sg.sample_scenes(
+        n, image_size=c.image_size, seed=c.seed + 990_001,
+        num_distractors=0, occlusion=False)
+    rng = np.random.default_rng(c.seed + 990_002)
+    # Class-balanced actions (synthetic_grasping.generate_grasps'
+    # positive_fraction convention): half near-object, half uniform, so
+    # the metric weighs the supervised arm (success -> 1) and the
+    # bootstrap arm (fail -> gamma) comparably instead of being
+    # dominated by whichever class random actions happen to produce.
+    actions = rng.uniform(-1.0, 1.0,
+                          (n, c.action_size)).astype(np.float32)
+    near = rng.random(n) < 0.5
+    noise = rng.normal(0.0, 0.12, (n, 2)).astype(np.float32)
+    actions[near, :2] = np.clip(targets[near] + noise[near], -1.0, 1.0)
+    success = sg.grasp_success(targets, actions,
+                               c.grasp_radius).astype(np.float32)
+    q_star = np.where(success > 0, 1.0, c.gamma).astype(np.float32)
+    batches, stars = [], []
+    for i in range(c.eval_batches):
+      part = slice(i * c.batch_size, (i + 1) * c.batch_size)
+      batches.append({
+          "image": images[part],
+          "action": actions[part],
+          "reward": success[part],
+          "done": success[part],
+          "next_image": images[part],
+      })
+      stars.append(q_star[part])
+    return batches, stars
+
+  def _eval(self, updater: BellmanUpdater, variables, eval_batches,
+            eval_q_stars) -> Dict[str, float]:
+    """|Q - Q*| and its square on the held-out set (one TD executable,
+    reused — targets here are the analytic constants, so eval adds no
+    CEM work and no extra compiled program)."""
+    tds = [updater.td_errors(variables, batch, q_star)
+           for batch, q_star in zip(eval_batches, eval_q_stars)]
+    td = np.concatenate(tds)
+    return {
+        "eval_td_error": float(np.mean(td)),
+        "eval_q_loss": float(np.mean(np.square(td))),
+    }
+
+  # --- the loop ------------------------------------------------------------
+
+  def run(self, num_steps: int) -> Dict:
+    """Runs the closed loop for `num_steps` optimizer steps."""
+    c = self.config
+    state = self.trainer.create_train_state(batch_size=c.batch_size)
+    # Host snapshot feeds the collector predictor and the target net
+    # (refreshed every K steps); the PER-STEP TD/eval path reads the
+    # live device-resident state.variables() instead — a full D2H
+    # fetch per optimizer step would stall the train pipeline for data
+    # discarded on refresh_every-1 of every refresh_every steps.
+    host_variables = self._host_variables(state)
+
+    predictor = _HotReloadPredictor(self.model, host_variables)
+    policy = self._make_policy(predictor)
+    updater = BellmanUpdater(
+        self.model, host_variables, action_size=c.action_size,
+        gamma=c.gamma,
+        num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
+        iterations=c.cem_iterations, seed=c.seed + 13,
+        polyak_tau=c.polyak_tau)
+
+    self._collectors = [
+        CollectorWorker(policy, self.queue, c.image_size,
+                        num_envs=c.envs_per_collector,
+                        max_attempts=c.max_attempts,
+                        seed=c.seed + i, grasp_radius=c.grasp_radius,
+                        exploration_epsilon=c.exploration_epsilon,
+                        scripted_fraction=c.scripted_fraction)
+        for i in range(c.num_collectors)
+    ]
+    for collector in self._collectors:
+      collector.start()
+
+    try:
+      self._wait_for_min_fill()
+      eval_batches, eval_q_stars = self._eval_transitions()
+      online = state.variables(use_ema=True)
+      initial_eval = self._eval(updater, online, eval_batches,
+                                eval_q_stars)
+      self.writer.write_scalars(
+          0, {"replay/" + k: v for k, v in initial_eval.items()})
+
+      train_step = None
+      eval_history = [dict(step=0, **initial_eval)]
+      final_metrics: Dict[str, float] = {}
+      for step in range(1, num_steps + 1):
+        self.feeder.drain()
+        batch, info = self.buffer.sample()
+        targets, q_next = updater.compute_targets(batch)
+        features = {"image": np.asarray(batch["image"]),
+                    "action": np.asarray(batch["action"])}
+        labels = {"target_q": targets}
+        sharded = self.trainer.shard_batch((features, labels))
+        if train_step is None:
+          # AOT once at the buffer's fixed shape: any later shape drift
+          # raises inside XLA's executable check instead of recompiling
+          # — this plus the ledger IS the "compiles exactly once" claim.
+          train_step = self.trainer.aot_train_step(state, *sharded)
+          self.compile_counts["train_step"] = (
+              self.compile_counts.get("train_step", 0) + 1)
+        state, metrics = train_step(state, *sharded)
+        # Valid until the NEXT train_step donates these buffers away;
+        # every read below happens before that.
+        online = state.variables(use_ema=True)
+        td = updater.td_errors(online, batch, targets)
+        self.buffer.update_priorities(info.indices, td)
+
+        if step % c.refresh_every == 0:
+          # The hot-reload path: collectors and the target net pull the
+          # freshest params; CEM executables are untouched (bucket-keyed).
+          host_variables = self._host_variables(state)
+          predictor.update(host_variables)
+          updater.refresh(host_variables, step)
+
+        if step % c.log_every == 0 or step == num_steps:
+          final_metrics = {
+              "replay/train_loss": float(metrics["loss"]),
+              "replay/train_td_error": float(np.mean(td)),
+              "replay/train_q_next": float(np.mean(q_next)),
+              "replay/sample_staleness": float(np.mean(info.staleness)),
+              "replay/target_lag": float(updater.target_lag(step)),
+              "replay/episodes": float(
+                  sum(col.episodes for col in self._collectors)),
+              **self.buffer.metrics(),
+              **self.feeder.metrics(),
+          }
+          self.writer.write_scalars(step, final_metrics)
+        if step % c.eval_every == 0 or step == num_steps:
+          evals = self._eval(updater, online, eval_batches,
+                             eval_q_stars)
+          eval_history.append(dict(step=step, **evals))
+          self.writer.write_scalars(
+              step, {"replay/" + k: v for k, v in evals.items()})
+    finally:
+      # Shutdown order matters: signal EVERY collector before joining
+      # any (one raising stop() must not leave siblings running and
+      # contending for CPU), always close the writer, and surface a
+      # collector error only when it wouldn't mask an in-flight
+      # exception from the loop body.
+      for collector in self._collectors:
+        collector.request_stop()
+      collector_errors = []
+      for collector in self._collectors:
+        collector._thread.join(30.0)
+        collector_errors.extend(collector.errors)
+      self.writer.close()
+    if collector_errors:
+      raise RuntimeError(
+          f"{len(collector_errors)} collector error(s); first shown"
+      ) from collector_errors[0]
+
+    final_eval = eval_history[-1]
+    reduction = 1.0 - (final_eval["eval_td_error"]
+                       / max(initial_eval["eval_td_error"], 1e-9))
+    ledger = dict(self.compile_counts)
+    ledger.update({f"bellman_{k}" if not k.startswith("bellman") else k: v
+                   for k, v in updater.compile_counts.items()})
+    ledger.update({f"cem_bucket_{k}": v
+                   for k, v in sorted(policy.compile_counts.items())})
+    return {
+        "steps": num_steps,
+        "initial_eval": initial_eval,
+        "final_eval": {k: v for k, v in final_eval.items()
+                       if k != "step"},
+        "eval_history": eval_history,
+        "eval_td_reduction": round(reduction, 4),
+        "compile_counts": ledger,
+        "queue": self.queue.stats(),
+        "buffer": self.buffer.metrics(),
+        "episodes_collected": sum(c_.episodes for c_ in self._collectors),
+        "collector_success_rate": (
+            sum(c_.successes for c_ in self._collectors)
+            / max(1, sum(c_.episodes for c_ in self._collectors))),
+        "param_refreshes": updater.refresh_count,
+        "logdir": self.logdir,
+    }
+
+  def _wait_for_min_fill(self) -> None:
+    """Gates the first optimizer step on buffer warm-up (min-fill)."""
+    deadline = time.monotonic() + self.config.min_fill_timeout_s
+    while not self.feeder.ready():
+      self.feeder.drain()
+      for collector in self._collectors:
+        if collector.errors:
+          raise RuntimeError("collector died during warm-up") from (
+              collector.errors[0])
+      if time.monotonic() > deadline:
+        raise TimeoutError(
+            f"replay buffer failed to reach min_fill="
+            f"{self.config.min_fill} within "
+            f"{self.config.min_fill_timeout_s}s "
+            f"(size={self.buffer.size})")
+      time.sleep(0.05)

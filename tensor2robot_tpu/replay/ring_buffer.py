@@ -1,0 +1,404 @@
+"""ReplayBuffer: fixed-shape in-memory ring over spec-validated transitions.
+
+The reference's QT-Opt replay was a distributed log-structured buffer
+feeding Bellman updaters (SURVEY.md §2 "QT-Opt research" — that fleet
+lives outside the reference repo); Podracer architectures (PAPERS.md,
+arXiv:2104.06272) rebuild the same loop TPU-natively with FIXED-SHAPE
+device-resident batching. This buffer is the host half of that shape
+contract:
+
+  - Storage is PREALLOCATED numpy, one array per flat spec key — append
+    is an O(1) slot write with wraparound, no Python-object churn, and
+    capacity is an honest bound (no hidden growth).
+  - Every transition is validated against a `TensorSpecStruct` at the
+    door (shape + dtype), so a malformed collector payload fails at
+    ingest with a key name, never as a shape error inside a compiled
+    train step hours later.
+  - `sample()` ALWAYS returns `sample_batch_size` transitions — with
+    replacement when underfilled — so the downstream train step traces
+    exactly once and never recompiles (the loop's recompile ledger
+    asserts this end to end).
+  - Sampling is seeded (one generator owned by the buffer) and either
+    uniform or prioritized: TD-error-proportional via replay/sum_tree
+    with the standard (|td| + eps)^alpha shaping; fresh appends get the
+    current max priority so new experience is seen at least once before
+    its TD error exists.
+
+Thread-safety: one lock guards append/sample/priority state. Collectors
+append from worker threads while the train thread samples; the lock is
+held only for numpy slot writes/gathers (microseconds), never across
+device work.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Mapping, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.replay.sum_tree import SumTree
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+@dataclass
+class SampleInfo:
+  """Bookkeeping riding along with a sampled batch.
+
+  indices: buffer slots of the batch (feed back to update_priorities).
+  staleness: per-item age in APPENDS (append_count at sample time minus
+    append_count when the slot was written) — the replay-health metric
+    the loop exports; rises when collection stalls behind training.
+  probabilities: per-item sampling probability (importance-weight hook;
+    uniform batches carry 1/size).
+  """
+  indices: np.ndarray
+  staleness: np.ndarray
+  probabilities: np.ndarray
+
+
+class ReplayBuffer:
+  """Sharded in-memory ring of spec-validated transitions."""
+
+  def __init__(
+      self,
+      transition_spec: ts.SpecStructure,
+      capacity: int,
+      sample_batch_size: int,
+      seed: int = 0,
+      prioritized: bool = False,
+      priority_exponent: float = 0.6,
+      min_priority: float = 1e-3,
+  ):
+    """Args:
+      transition_spec: flat-or-nested spec structure; one storage array
+        is preallocated per flat key.
+      capacity: ring size in transitions.
+      sample_batch_size: THE batch shape every sample() emits — fixed at
+        construction so consumers compile once.
+      seed: the buffer's single RNG seed (sampling determinism).
+      prioritized: TD-proportional sampling via a sum tree; False =
+        seeded uniform.
+      priority_exponent: alpha in p = (|td| + min_priority)^alpha;
+        0 recovers uniform-with-tree.
+      min_priority: epsilon floor so zero-TD transitions stay reachable.
+    """
+    if capacity < 1:
+      raise ValueError(f"capacity must be >= 1, got {capacity}")
+    if sample_batch_size < 1:
+      raise ValueError(
+          f"sample_batch_size must be >= 1, got {sample_batch_size}")
+    self._spec = ts.flatten_spec_structure(transition_spec)
+    if not list(self._spec.keys()):
+      raise ValueError("transition_spec has no leaves")
+    self.capacity = capacity
+    self.sample_batch_size = sample_batch_size
+    self._storage: Dict[str, np.ndarray] = {
+        key: np.zeros((capacity,) + spec.shape, np.dtype(spec.dtype))
+        for key, spec in self._spec.items()
+    }
+    self._rng = np.random.default_rng(seed)
+    self._lock = threading.Lock()
+    self._next = 0
+    self._size = 0
+    self._append_count = 0
+    # Append index at which each slot was last written (staleness).
+    self._written_at = np.zeros(capacity, np.int64)
+    self._prioritized = prioritized
+    self._alpha = priority_exponent
+    self._min_priority = min_priority
+    self._tree = SumTree(capacity) if prioritized else None
+    self._max_priority = 1.0
+
+  # --- writes --------------------------------------------------------------
+
+  def append(self, transition: Mapping[str, np.ndarray]) -> int:
+    """Validates + writes one transition; returns the slot. O(1)."""
+    arrays = self._validate(transition, batched=False)
+    with self._lock:
+      slot = self._next
+      for key, array in arrays.items():
+        self._storage[key][slot] = array
+      self._written_at[slot] = self._append_count
+      self._append_count += 1
+      self._next = (self._next + 1) % self.capacity
+      self._size = min(self._size + 1, self.capacity)
+      if self._tree is not None:
+        # Max-priority insert: unseen experience outranks everything
+        # until its first TD error arrives via update_priorities.
+        self._tree.set(slot, self._max_priority)
+    return slot
+
+  def extend(self, transitions: Mapping[str, np.ndarray]) -> int:
+    """Appends a batch (leading axis on every leaf); returns count."""
+    arrays = self._validate(transitions, batched=True)
+    n = next(iter(arrays.values())).shape[0]
+    for i in range(n):
+      self.append({key: array[i] for key, array in arrays.items()})
+    return n
+
+  # --- reads ---------------------------------------------------------------
+
+  def sample(self) -> Tuple[ts.TensorSpecStruct, SampleInfo]:
+    """One fixed-shape batch + its SampleInfo.
+
+    Underfilled buffers sample with replacement over the filled prefix
+    (min-fill gating in replay/ingest keeps the loop from training on
+    those, but the shape contract holds regardless).
+    """
+    with self._lock:
+      if self._size == 0:
+        raise ValueError("cannot sample from an empty ReplayBuffer")
+      n = self.sample_batch_size
+      if self._tree is not None and self._tree.total > 0:
+        indices = self._tree.sample(self._rng.random(n))
+        # Float-edge descents can exit on a zero-mass leaf (and the
+        # tree's out-of-range clamp lands on capacity-1, an UNWRITTEN
+        # slot while the ring is underfilled): remap any zero-priority
+        # pick onto the filled prefix instead of emitting the zeroed
+        # storage init as a transition.
+        zero = self._tree.get(indices) <= 0.0
+        probabilities = self._tree.get(indices) / self._tree.total
+        if zero.any():
+          indices = np.asarray(indices).copy()
+          indices[zero] = self._rng.integers(0, self._size,
+                                             int(zero.sum()))
+          # Remapped picks were drawn UNIFORMLY over the filled prefix
+          # — report that probability, not the landing slot's priority,
+          # or importance weights correct for the wrong distribution.
+          probabilities = probabilities.copy()
+          probabilities[zero] = 1.0 / self._size
+      else:
+        indices = self._rng.integers(0, self._size, n)
+        probabilities = np.full(n, 1.0 / self._size)
+      batch = ts.TensorSpecStruct({
+          key: array[indices].copy()
+          for key, array in self._storage.items()
+      })
+      staleness = self._append_count - self._written_at[indices]
+    return batch, SampleInfo(indices=np.asarray(indices, np.int64),
+                             staleness=np.asarray(staleness, np.int64),
+                             probabilities=probabilities)
+
+  def update_priorities(self, indices, td_errors) -> None:
+    """TD-error-proportional priority refresh for sampled slots."""
+    if self._tree is None:
+      return
+    td = np.abs(np.asarray(td_errors, np.float64)).reshape(-1)
+    priorities = (td + self._min_priority) ** self._alpha
+    with self._lock:
+      self._tree.set(np.asarray(indices, np.int64).reshape(-1),
+                     priorities)
+      self._max_priority = max(self._max_priority,
+                               float(priorities.max(initial=0.0)))
+
+  # --- health metrics ------------------------------------------------------
+
+  @property
+  def size(self) -> int:
+    return self._size
+
+  @property
+  def append_count(self) -> int:
+    return self._append_count
+
+  @property
+  def fill_fraction(self) -> float:
+    return self._size / self.capacity
+
+  def priority_entropy(self) -> float:
+    """Normalized entropy (0..1) of the sampling distribution.
+
+    1.0 = uniform (also reported for uniform buffers); falling entropy
+    means priority mass is concentrating on few transitions — the
+    overfit-to-outliers failure mode prioritized replay must be watched
+    for, hence a first-class loop metric.
+    """
+    with self._lock:
+      if self._size <= 1:
+        return 1.0
+      if self._tree is None:
+        return 1.0
+      leaves = self._tree.leaves(self._size)
+    total = leaves.sum()
+    if total <= 0:
+      return 1.0
+    p = leaves / total
+    p = p[p > 0]
+    return float(-(p * np.log(p)).sum() / np.log(self._size))
+
+  def metrics(self) -> Dict[str, float]:
+    """The buffer's scalar health block (metric_writer-ready)."""
+    return {
+        "replay/fill_fraction": self.fill_fraction,
+        "replay/size": float(self._size),
+        "replay/append_count": float(self._append_count),
+        "replay/priority_entropy": self.priority_entropy(),
+    }
+
+  # --- validation ----------------------------------------------------------
+
+  def _validate(self, transition: Mapping[str, np.ndarray],
+                batched: bool) -> Dict[str, np.ndarray]:
+    """Spec-driven door check: exact keys, shapes, castable dtypes."""
+    return _validate_against_spec(self._spec, transition, batched)
+
+
+class ShardedReplayBuffer:
+  """N independent ReplayBuffer shards behind one buffer interface.
+
+  The distributed-replay shape of the reference's QT-Opt log buffer:
+  many collector processes append without contending on one lock, and
+  sampling gathers a FIXED per-shard quota so the emitted batch shape
+  never changes. Here the shards are in-process (threaded collectors);
+  the interface — striped append, quota sampling, global slot ids for
+  priority updates — is the one a cross-host implementation keeps.
+
+  Sharding rules:
+    - append() stripes round-robin (one atomic counter, no hot shard);
+    - sample() draws sample_batch_size / num_shards from EVERY shard
+      and concatenates, so one stalled collector shows up as rising
+      staleness in its stripe, never as a shape change;
+    - global index = shard * shard_capacity + local slot, so
+      update_priorities routes back without a lookup table.
+  """
+
+  def __init__(self, transition_spec, capacity: int,
+               sample_batch_size: int, num_shards: int = 2,
+               seed: int = 0, **buffer_kwargs):
+    if num_shards < 1:
+      raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if capacity % num_shards:
+      raise ValueError(
+          f"capacity {capacity} not divisible by num_shards {num_shards}")
+    if sample_batch_size % num_shards:
+      raise ValueError(
+          f"sample_batch_size {sample_batch_size} not divisible by "
+          f"num_shards {num_shards}")
+    self.num_shards = num_shards
+    self.capacity = capacity
+    self.sample_batch_size = sample_batch_size
+    self._shard_capacity = capacity // num_shards
+    self._quota = sample_batch_size // num_shards
+    # Distinct per-shard seeds: identical streams would correlate the
+    # stripes' samples.
+    self._shards = [
+        ReplayBuffer(transition_spec, self._shard_capacity,
+                     self._quota, seed=seed + 1000 * i, **buffer_kwargs)
+        for i in range(num_shards)
+    ]
+    self._spec = self._shards[0]._spec
+    self._lock = threading.Lock()
+    self._stripe = 0
+
+  def append(self, transition: Mapping[str, np.ndarray]) -> int:
+    with self._lock:
+      shard = self._stripe
+      self._stripe = (self._stripe + 1) % self.num_shards
+    slot = self._shards[shard].append(transition)
+    return shard * self._shard_capacity + slot
+
+  def extend(self, transitions: Mapping[str, np.ndarray]) -> int:
+    # Validate the WHOLE batch first (mismatched leading dims fail here
+    # with a named key), so a bad payload can never partially stripe
+    # into the shards before raising.
+    arrays = _validate_against_spec(self._spec, transitions, batched=True)
+    n = next(iter(arrays.values())).shape[0]
+    for i in range(n):
+      self.append({key: array[i] for key, array in arrays.items()})
+    return n
+
+  def sample(self) -> Tuple[ts.TensorSpecStruct, SampleInfo]:
+    parts = [shard.sample() for shard in self._shards]
+    keys = list(dict(parts[0][0]).keys())
+    batch = ts.TensorSpecStruct({
+        key: np.concatenate([dict(b)[key] for b, _ in parts])
+        for key in keys
+    })
+    info = SampleInfo(
+        indices=np.concatenate([
+            info.indices + i * self._shard_capacity
+            for i, (_, info) in enumerate(parts)]),
+        # Shards count only their own (1/N of global, round-robin)
+        # appends; scale to GLOBAL appends so the staleness metric is
+        # invariant to num_shards instead of shrinking N-fold.
+        staleness=np.concatenate(
+            [info.staleness * self.num_shards for _, info in parts]),
+        probabilities=np.concatenate(
+            # Uniform-over-shards mixture: each stripe contributes its
+            # quota, so the global probability is the shard's / N.
+            [info.probabilities / self.num_shards for _, info in parts]),
+    )
+    return batch, info
+
+  def update_priorities(self, indices, td_errors) -> None:
+    indices = np.asarray(indices, np.int64).reshape(-1)
+    td = np.asarray(td_errors, np.float64).reshape(-1)
+    shard_of = indices // self._shard_capacity
+    local = indices % self._shard_capacity
+    for i, shard in enumerate(self._shards):
+      mask = shard_of == i
+      if mask.any():
+        shard.update_priorities(local[mask], td[mask])
+
+  @property
+  def size(self) -> int:
+    return sum(shard.size for shard in self._shards)
+
+  @property
+  def append_count(self) -> int:
+    return sum(shard.append_count for shard in self._shards)
+
+  @property
+  def fill_fraction(self) -> float:
+    return self.size / self.capacity
+
+  def priority_entropy(self) -> float:
+    """Mean of per-shard normalized entropies (each already 0..1)."""
+    return float(np.mean(
+        [shard.priority_entropy() for shard in self._shards]))
+
+  def metrics(self) -> Dict[str, float]:
+    return {
+        "replay/fill_fraction": self.fill_fraction,
+        "replay/size": float(self.size),
+        "replay/append_count": float(self.append_count),
+        "replay/priority_entropy": self.priority_entropy(),
+    }
+
+
+def _validate_against_spec(spec_struct, transition: Mapping[str, np.ndarray],
+                           batched: bool) -> Dict[str, np.ndarray]:
+  """Spec-driven door check: exact keys, shapes, castable dtypes."""
+  flat = (dict(transition.items()) if isinstance(
+      transition, ts.TensorSpecStruct)
+          else dict(ts.TensorSpecStruct(transition).items()))
+  missing = [k for k in spec_struct if k not in flat]
+  extra = [k for k in flat if k not in spec_struct]
+  if missing or extra:
+    raise ValueError(
+        f"transition keys disagree with spec: missing={missing} "
+        f"extra={extra}")
+  out = {}
+  batch = None
+  for key, spec in spec_struct.items():
+    array = np.asarray(flat[key])
+    expected = spec.shape
+    got = array.shape[1:] if batched else array.shape
+    if tuple(got) != tuple(expected):
+      raise ValueError(
+          f"{key}: shape {tuple(array.shape)} does not match spec "
+          f"{tuple(expected)}{' (+ leading batch)' if batched else ''}")
+    if batched:
+      if batch is None:
+        batch = array.shape[0]
+      elif array.shape[0] != batch:
+        raise ValueError(
+            f"{key}: leading batch {array.shape[0]} != {batch}")
+    if not np.can_cast(array.dtype, spec.dtype, casting="same_kind"):
+      raise ValueError(
+          f"{key}: dtype {array.dtype} not same-kind castable to "
+          f"spec {np.dtype(spec.dtype)}")
+    out[key] = array.astype(spec.dtype, copy=False)
+  return out

@@ -1,0 +1,87 @@
+"""TinyQCriticModel: a CI-scale critic for the replay loop's smoke lane.
+
+Sibling of serving/smoke.TinyQPredictor, same rationale: the tier-1
+lane must prove the SUBSYSTEM — ring buffer, Bellman updater, hot
+param refresh, recompile ledger, metric flow — not conv-tower
+learnability. The flagship QTOptGraspingModel's global-average-pool
+architecture needs ~1.2k+ optimizer steps before its Q discriminates
+grasp position (the calibrated qtopt capability scale,
+bin/run_capability_checks._SCALES; verified again while building this
+package: at CI budgets it fits only the success base rate, so the CEM
+max never rises and no TD metric can witness learning). This critic is
+the same (image, action) → q_predicted contract as a CriticModel with
+a function class sized to converge in a few hundred CPU steps: flatten
+→ position code, action embedding, joint MLP head — enough to learn
+"commanded (x, y) near the object" at 16 px, nothing more.
+
+The smoke's acceptance claim (tests/test_replay.py): trained PURELY
+off-policy through the collect → replay → Bellman-label → train loop,
+eval TD-error vs the retry env's analytic fixed point
+(Q* = success ? 1 : gamma) drops ≥ 30% from its step-0 value — which
+requires real value propagation through the CEM max, because failed
+grasps are only ever labelled gamma * max_a' Q_target, never with an
+observed return.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.models.critic_model import CriticModel
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+SMOKE_IMAGE_SIZE = 16
+SMOKE_ACTION_SIZE = 4
+
+
+class _TinyQModule(nn.Module):
+  """Flatten image → position code; action embed; joint MLP → q logit."""
+
+  @nn.compact
+  def __call__(self, features, mode: str):
+    del mode  # no train/eval asymmetry (no dropout, no batch stats)
+    image = features["image"].astype(jnp.float32) / 255.0
+    x = image.reshape((image.shape[0], -1))
+    x = nn.relu(nn.Dense(64, name="img_fc1")(x))
+    code = nn.Dense(32, name="img_code")(x)
+    action = nn.relu(nn.Dense(
+        32, name="act_fc1")(features["action"].astype(jnp.float32)))
+    h = jnp.concatenate([code, action], axis=-1)
+    h = nn.relu(nn.Dense(64, name="joint_fc1")(h))
+    h = nn.relu(nn.Dense(32, name="joint_fc2")(h))
+    q_logit = nn.Dense(1, name="q_head")(h)[:, 0]
+    return ts.TensorSpecStruct({"q_predicted": q_logit})
+
+
+class TinyQCriticModel(CriticModel):
+  """(uint8 image, action) → grasp Q, ms-scale, uint8 wire like the
+  flagship so the replay loop's transition schema is identical."""
+
+  def __init__(self, image_size: int = SMOKE_IMAGE_SIZE,
+               action_size: int = SMOKE_ACTION_SIZE, **kwargs):
+    kwargs.setdefault("compute_dtype", jnp.float32)
+    super().__init__(**kwargs)
+    self._image_size = image_size
+    self._action_size = action_size
+
+  def get_feature_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return ts.TensorSpecStruct({
+        "image": ts.ExtendedTensorSpec(
+            (self._image_size, self._image_size, 3), np.uint8,
+            name="image"),
+        "action": ts.ExtendedTensorSpec(
+            (self._action_size,), np.float32, name="action"),
+    })
+
+  def get_label_specification(self, mode: str) -> ts.TensorSpecStruct:
+    del mode
+    return ts.TensorSpecStruct({
+        self.target_key: ts.ExtendedTensorSpec(
+            (), np.float32, name=self.target_key),
+    })
+
+  def build_module(self) -> nn.Module:
+    return _TinyQModule()

@@ -97,6 +97,30 @@ def batched_cem_optimize(
       **kwargs)
 
 
+def make_tiled_q_score_fn(fn, variables):
+  """The canonical per-state Q score_fn for `fleet_cem_optimize`.
+
+  Tiles ONE state's image across its candidate actions and scores the
+  batch through a ``(variables, features) -> {"q_predicted"}`` device
+  fn. Serving's batched control step (serving/policy.py) and the
+  Bellman updater's target max (replay/bellman.py) MUST score through
+  the same wire contract — actions served and actions that label
+  training targets diverging silently is the worst QT-Opt failure mode
+  — so both build their score_fn here.
+
+  Image dtype passes through untouched (the model's wire format:
+  float32, or uint8 on the bandwidth-saving path).
+  """
+  def score(image, actions):
+    tiled = jnp.broadcast_to(image[None],
+                             (actions.shape[0],) + image.shape)
+    outputs = fn(variables, {"image": tiled,
+                             "action": actions.astype(jnp.float32)})
+    return jnp.reshape(outputs["q_predicted"], (-1,))
+
+  return score
+
+
 def fleet_cem_optimize(
     score_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
     states: jnp.ndarray,

@@ -136,6 +136,74 @@ def write_tfrecords(
   return path
 
 
+class GraspRetryEnv:
+  """Multi-attempt grasping episode over one fixed scene.
+
+  The replay/Bellman loop needs episodes where bootstrapping MATTERS —
+  the logged-grasp dataset above is single-step (target == reward), so
+  a Bellman updater degenerates to supervised labels on it. This env
+  wraps the same scene/success machinery as a retry process: the robot
+  keeps the scene, attempts a grasp per step, and the episode ends on
+  success or after `max_attempts`. The state is static (the scene
+  image), so the optimal Q is the fixed point
+
+      Q*(s, a) = success(a) + gamma * (1 - success(a)) * max_a' Q*(s, a')
+
+  — failed grasps bootstrap through the NEXT attempt's value, which is
+  exactly the propagation path the updater must compute via CEM.
+  Truncation at max_attempts is reported separately from success so the
+  ingest layer can bootstrap through it (done=0) rather than treating
+  "ran out of budget" as "the scene has no value".
+  """
+
+  def __init__(self, image_size: int = 64, max_attempts: int = 4,
+               radius: float = GRASP_RADIUS, num_distractors: int = 0,
+               occlusion: bool = False):
+    self._image_size = image_size
+    self._max_attempts = max_attempts
+    self._radius = radius
+    self._num_distractors = num_distractors
+    self._occlusion = occlusion
+    self._image: Optional[np.ndarray] = None
+    self._target: Optional[np.ndarray] = None
+    self._attempts = 0
+
+  def reset(self, seed: int) -> np.ndarray:
+    """New scene; returns its uint8 (S, S, 3) image."""
+    images, targets = sample_scenes(
+        1, image_size=self._image_size, seed=seed,
+        num_distractors=self._num_distractors,
+        occlusion=self._occlusion)
+    self._image, self._target = images[0], targets[0]
+    self._attempts = 0
+    return self._image
+
+  @property
+  def image(self) -> np.ndarray:
+    assert self._image is not None, "call reset() first"
+    return self._image
+
+  @property
+  def target(self) -> np.ndarray:
+    assert self._target is not None, "call reset() first"
+    return self._target
+
+  def step(self, action: np.ndarray):
+    """One grasp attempt.
+
+    Returns:
+      (reward, done, truncated): reward 1.0 on success; done mirrors
+      success (the scene is solved); truncated flags the attempt-budget
+      exhaustion on a FAILED last attempt (bootstrap through it).
+    """
+    assert self._image is not None, "call reset() first"
+    self._attempts += 1
+    success = bool(grasp_success(self._target, np.asarray(action),
+                                 self._radius))
+    truncated = (not success) and self._attempts >= self._max_attempts
+    return float(success), success, truncated
+
+
 def evaluate_grasp_policy(
     policy: Callable[[np.ndarray], np.ndarray],
     num_scenes: int = 100,

@@ -100,15 +100,12 @@ class CEMFleetPolicy:
       base = jax.random.key(self._seed)
       keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(seeds)
 
-      def score(image, actions):
-        # Tile ONE client's image across its candidate actions; under
-        # the fleet vmap this becomes one (B*num_samples) Q call per
-        # CEM iteration — the Podracer-style batched on-device step.
-        tiled = jnp.broadcast_to(image[None],
-                                 (actions.shape[0],) + image.shape)
-        outputs = fn(variables, {"image": tiled,
-                                 "action": actions.astype(jnp.float32)})
-        return jnp.reshape(outputs["q_predicted"], (-1,))
+      # Tile ONE client's image across its candidate actions; under
+      # the fleet vmap this becomes one (B*num_samples) Q call per
+      # CEM iteration — the Podracer-style batched on-device step.
+      # Shared with the Bellman updater's target max (same wire
+      # contract, by construction).
+      score = cem.make_tiled_q_score_fn(fn, variables)
 
       best, _ = cem.fleet_cem_optimize(
           score, images, keys, self._action_size,

@@ -340,6 +340,18 @@ class Trainer:
       self._train_steps = self._build_train_steps()
     return self._train_steps(state, features, labels)
 
+  def aot_train_step(self, state: TrainState, features, labels=None):
+    """AOT-lowered+compiled SINGLE train step for the same arguments.
+
+    The replay loop's recompile ledger hangs on this: an AOT executable
+    rejects any later shape/dtype drift instead of silently retracing,
+    turning "the fixed-shape sampler never recompiles the train step"
+    from a hope into an enforced invariant. Shares `train_step`'s
+    donation semantics (pass back the state it returns)."""
+    if self._train_step is None:
+      self._train_step = self._build_train_step()
+    return self._train_step.lower(state, features, labels).compile()
+
   def aot_train_steps(self, state: TrainState, features, labels=None):
     """AOT-lowered+compiled `train_steps` executable for the same
     arguments. Exposes XLA's per-executable introspection

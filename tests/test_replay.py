@@ -1,0 +1,432 @@
+"""Replay subsystem: ring buffer, sum tree, ingest, Bellman, loop smoke.
+
+Covers the ISSUE 3 edge-case checklist — wraparound overwrite
+correctness, seeded sampling determinism, sum-tree priority
+update/renormalization, min-fill gating, drop-oldest backpressure
+accounting — plus the subsystem acceptance smoke: a tiny critic trained
+PURELY off-policy through the collect→replay→Bellman→train loop reduces
+eval TD-error vs the retry env's analytic fixed point by >= 30%, with a
+recompile ledger asserting exactly one executable per compiled function
+(fixed-shape sampling never recompiles) and the replay-health metrics
+flowing through metric_writer.
+"""
+
+import json
+import os
+
+import numpy as np
+import optax
+import pytest
+
+from tensor2robot_tpu.replay.bellman import BellmanUpdater
+from tensor2robot_tpu.replay.ingest import (ReplayFeeder, TransitionQueue,
+                                            episode_to_transitions)
+from tensor2robot_tpu.replay.loop import transition_spec
+from tensor2robot_tpu.replay.ring_buffer import (ReplayBuffer,
+                                                 ShardedReplayBuffer)
+from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+from tensor2robot_tpu.replay.sum_tree import SumTree
+
+IMG = 8  # tiny transition images for the structural tests
+
+
+def _transition(i, img=IMG, action_size=4, reward=0.0, done=0.0):
+  return {
+      "image": np.full((img, img, 3), i % 256, np.uint8),
+      "action": np.full((action_size,), float(i), np.float32),
+      "reward": np.float32(reward),
+      "done": np.float32(done),
+      "next_image": np.full((img, img, 3), (i + 1) % 256, np.uint8),
+  }
+
+
+class TestSumTree:
+
+  def test_total_and_proportional_sampling(self):
+    tree = SumTree(5)
+    tree.set([0, 1, 2, 3, 4], [1.0, 2.0, 3.0, 4.0, 0.0])
+    assert tree.total == pytest.approx(10.0)
+    rng = np.random.default_rng(0)
+    counts = np.bincount(tree.sample(rng.random(20_000)), minlength=5)
+    np.testing.assert_allclose(counts / 20_000,
+                               [0.1, 0.2, 0.3, 0.4, 0.0], atol=0.02)
+
+  def test_zero_priority_leaf_never_sampled(self):
+    tree = SumTree(4)
+    tree.set([0, 1, 2, 3], [1.0, 0.0, 2.0, 0.0])
+    samples = tree.sample(np.random.default_rng(1).random(5_000))
+    assert set(np.unique(samples)) <= {0, 2}
+
+  def test_update_and_renormalization(self):
+    """Priority updates must keep every ancestor the exact sum of its
+    children — recomputed, not delta-propagated, so float drift can't
+    accumulate over many updates."""
+    rng = np.random.default_rng(2)
+    tree = SumTree(33)  # off-power-of-two on purpose
+    for _ in range(200):
+      idx = rng.integers(0, 33, size=8)
+      tree.set(idx, rng.random(8))
+    assert tree.total == pytest.approx(tree.leaves(33).sum(), abs=1e-12)
+    # Zeroing everything renormalizes to an unsampleable empty tree.
+    tree.set(np.arange(33), np.zeros(33))
+    assert tree.total == 0.0
+    with pytest.raises(ValueError):
+      tree.sample(np.array([0.5]))
+
+  def test_duplicate_indices_last_value_wins(self):
+    tree = SumTree(4)
+    tree.set([2, 2, 2], [5.0, 7.0, 1.0])
+    assert tree.get([2])[0] == pytest.approx(1.0)
+    assert tree.total == pytest.approx(1.0)
+
+  def test_rejects_bad_inputs(self):
+    tree = SumTree(4)
+    with pytest.raises(IndexError):
+      tree.set([4], [1.0])
+    with pytest.raises(ValueError):
+      tree.set([0], [-1.0])
+    with pytest.raises(ValueError):
+      tree.set([0], [np.nan])
+
+
+class TestReplayBuffer:
+
+  def _buffer(self, capacity=4, batch=8, **kwargs):
+    return ReplayBuffer(transition_spec(IMG, 4), capacity=capacity,
+                        sample_batch_size=batch, seed=0, **kwargs)
+
+  def test_wraparound_overwrite_correctness(self):
+    """6 appends into capacity 4: slots cycle, survivors are the last
+    4 transitions, and append() reports the wrapped slot ids."""
+    buf = self._buffer()
+    slots = [buf.append(_transition(i, reward=float(i)))
+             for i in range(6)]
+    assert slots == [0, 1, 2, 3, 0, 1]
+    assert buf.size == 4 and buf.append_count == 6
+    assert buf.fill_fraction == 1.0
+    batch, _ = buf.sample()
+    rewards = set(np.asarray(batch["reward"]).tolist())
+    assert rewards <= {2.0, 3.0, 4.0, 5.0}
+    # The overwritten slot holds the NEW transition's payload.
+    assert float(buf._storage["reward"][0]) == 4.0
+
+  def test_fixed_batch_shape_even_underfilled(self):
+    buf = self._buffer(capacity=16, batch=8)
+    buf.append(_transition(0))
+    batch, info = buf.sample()
+    assert np.asarray(batch["image"]).shape == (8, IMG, IMG, 3)
+    assert info.indices.shape == (8,)
+
+  def test_seeded_sampling_determinism(self):
+    """Same seed + same appends -> identical sample streams; a
+    different seed diverges."""
+    def stream(seed):
+      buf = ReplayBuffer(transition_spec(IMG, 4), capacity=8,
+                         sample_batch_size=4, seed=seed)
+      for i in range(8):
+        buf.append(_transition(i))
+      return [buf.sample()[1].indices.tolist() for _ in range(5)]
+
+    assert stream(7) == stream(7)
+    assert stream(7) != stream(8)
+
+  def test_spec_validation_at_the_door(self):
+    buf = self._buffer()
+    bad_shape = _transition(0)
+    bad_shape["action"] = np.zeros((5,), np.float32)
+    with pytest.raises(ValueError, match="action"):
+      buf.append(bad_shape)
+    bad_dtype = _transition(0)
+    # float -> uint8 is not same-kind castable (int64 -> float32 IS,
+    # and is deliberately allowed: collectors hand over python floats).
+    bad_dtype["image"] = np.zeros((IMG, IMG, 3), np.float32)
+    with pytest.raises(ValueError, match="castable"):
+      buf.append(bad_dtype)
+    with pytest.raises(ValueError, match="missing"):
+      buf.append({k: v for k, v in _transition(0).items()
+                  if k != "reward"})
+    extra = dict(_transition(0), bogus=np.zeros(1))
+    with pytest.raises(ValueError, match="extra"):
+      buf.append(extra)
+
+  def test_staleness_counts_appends_since_write(self):
+    buf = self._buffer(capacity=8, batch=4)
+    for i in range(8):
+      buf.append(_transition(i))
+    _, info = buf.sample()
+    # Slot i was written at append i; staleness = 8 - i in [1, 8].
+    expected = 8 - info.indices
+    np.testing.assert_array_equal(info.staleness, expected)
+
+  def test_prioritized_sampling_follows_td_updates(self):
+    buf = self._buffer(capacity=4, batch=8, prioritized=True,
+                       priority_exponent=1.0)
+    for i in range(4):
+      buf.append(_transition(i))
+    buf.update_priorities([0, 1, 2, 3], [0.0, 0.0, 0.0, 10.0])
+    _, info = buf.sample()
+    # Slot 3 holds ~1000x the mass of the epsilon-floored others.
+    assert np.mean(info.indices == 3) > 0.8
+
+  def test_fresh_append_gets_max_priority(self):
+    buf = self._buffer(capacity=4, batch=8, prioritized=True,
+                       priority_exponent=1.0)
+    for i in range(3):
+      buf.append(_transition(i))
+    buf.update_priorities([0, 1, 2], [5.0, 0.0, 0.0])
+    buf.append(_transition(3))  # must enter at current max (~5)
+    counts = np.zeros(4)
+    for _ in range(30):
+      _, info = buf.sample()
+      counts += np.bincount(info.indices, minlength=4)
+    assert counts[3] > counts[1] and counts[3] > counts[2]
+    assert counts[3] == pytest.approx(counts[0], rel=0.35)
+
+  def test_priority_entropy_tracks_concentration(self):
+    buf = self._buffer(capacity=4, batch=4, prioritized=True,
+                       priority_exponent=1.0)
+    for i in range(4):
+      buf.append(_transition(i))
+    uniform_entropy = buf.priority_entropy()
+    buf.update_priorities([0, 1, 2, 3], [100.0, 0.0, 0.0, 0.0])
+    assert buf.priority_entropy() < uniform_entropy
+    assert 0.0 <= buf.priority_entropy() <= 1.0
+
+  def test_metrics_block_keys(self):
+    buf = self._buffer()
+    buf.append(_transition(0))
+    metrics = buf.metrics()
+    for key in ("replay/fill_fraction", "replay/size",
+                "replay/append_count", "replay/priority_entropy"):
+      assert key in metrics
+
+
+class TestShardedReplayBuffer:
+
+  def test_striped_append_and_global_priority_routing(self):
+    buf = ShardedReplayBuffer(transition_spec(IMG, 4), capacity=8,
+                              sample_batch_size=4, num_shards=2,
+                              seed=0, prioritized=True,
+                              priority_exponent=1.0)
+    slots = [buf.append(_transition(i)) for i in range(8)]
+    # Round-robin striping: even appends land in shard 0 (slots 0..3),
+    # odd in shard 1 (global slots 4..7).
+    assert slots == [0, 4, 1, 5, 2, 6, 3, 7]
+    batch, info = buf.sample()
+    assert np.asarray(batch["image"]).shape == (4, IMG, IMG, 3)
+    # Global indices route back to the owning shard's tree.
+    buf.update_priorities(np.arange(8), [9, 0, 0, 0, 9, 0, 0, 0])
+    assert buf._shards[0]._tree.get([0])[0] > 1.0
+    assert buf._shards[1]._tree.get([0])[0] > 1.0
+    assert buf._shards[0]._tree.get([1])[0] < 1.0
+
+  def test_divisibility_contracts(self):
+    spec = transition_spec(IMG, 4)
+    with pytest.raises(ValueError, match="divisible"):
+      ShardedReplayBuffer(spec, capacity=9, sample_batch_size=4,
+                          num_shards=2)
+    with pytest.raises(ValueError, match="divisible"):
+      ShardedReplayBuffer(spec, capacity=8, sample_batch_size=3,
+                          num_shards=2)
+
+
+class TestIngest:
+
+  def _episode(self, t=3):
+    return {
+        "images": np.stack(
+            [np.full((IMG, IMG, 3), i, np.uint8) for i in range(t + 1)]),
+        "actions": np.zeros((t, 4), np.float32),
+        "rewards": np.arange(t, dtype=np.float32),
+        "dones": np.zeros((t,), np.float32),
+    }
+
+  def test_episode_flattening_aligns_next_image(self):
+    transitions = episode_to_transitions(self._episode(3))
+    assert len(transitions) == 3
+    for i, tr in enumerate(transitions):
+      assert tr["image"][0, 0, 0] == i
+      assert tr["next_image"][0, 0, 0] == i + 1
+      assert tr["reward"] == float(i)
+
+  def test_stream_length_validation(self):
+    episode = self._episode(3)
+    episode["images"] = episode["images"][:3]  # needs T+1
+    with pytest.raises(ValueError, match="disagree on length"):
+      episode_to_transitions(episode)
+
+  def test_drop_oldest_backpressure_accounting(self):
+    queue = TransitionQueue(capacity=3)
+    for i in range(5):
+      queue.put(_transition(i))
+    stats = queue.stats()
+    assert stats == {"enqueued": 5, "dropped": 2, "dequeued": 0,
+                     "pending": 3}
+    drained = queue.drain()
+    # Oldest were shed: survivors are the 3 newest, FIFO order.
+    assert [t["action"][0] for t in drained] == [2.0, 3.0, 4.0]
+    assert queue.stats()["dequeued"] == 3
+    # Conservation: enqueued == dropped + dequeued + pending.
+    stats = queue.stats()
+    assert stats["enqueued"] == (stats["dropped"] + stats["dequeued"]
+                                 + stats["pending"])
+
+  def test_min_fill_gating(self):
+    queue = TransitionQueue(capacity=16)
+    buf = ReplayBuffer(transition_spec(IMG, 4), capacity=16,
+                       sample_batch_size=4, seed=0)
+    feeder = ReplayFeeder(queue, buf, min_fill=3)
+    assert not feeder.ready()
+    queue.put(_transition(0))
+    queue.put(_transition(1))
+    feeder.drain()
+    assert not feeder.ready()  # 2 < min_fill
+    queue.put(_transition(2))
+    feeder.drain()
+    assert feeder.ready()
+    assert feeder.metrics()["replay/min_fill_ready"] == 1.0
+
+  def test_min_fill_must_be_reachable(self):
+    queue = TransitionQueue(capacity=4)
+    buf = ReplayBuffer(transition_spec(IMG, 4), capacity=4,
+                       sample_batch_size=4, seed=0)
+    with pytest.raises(ValueError, match="never open"):
+      ReplayFeeder(queue, buf, min_fill=5)
+
+
+class TestBellmanUpdater:
+
+  def _updater(self, gamma=0.8, **kwargs):
+    model = TinyQCriticModel(image_size=IMG,
+                             optimizer_fn=lambda: optax.adam(1e-3))
+    import jax
+    variables = jax.device_get(
+        model.init_variables(jax.random.key(0), batch_size=2))
+    return model, variables, BellmanUpdater(
+        model, variables, action_size=4, gamma=gamma, num_samples=8,
+        num_elites=2, iterations=2, seed=0, **kwargs)
+
+  def _batch(self, n=4, reward=None, done=None):
+    rng = np.random.default_rng(0)
+    return {
+        "image": rng.integers(0, 255, (n, IMG, IMG, 3), np.uint8),
+        "action": rng.uniform(-1, 1, (n, 4)).astype(np.float32),
+        "reward": (np.zeros(n, np.float32) if reward is None
+                   else np.asarray(reward, np.float32)),
+        "done": (np.zeros(n, np.float32) if done is None
+                 else np.asarray(done, np.float32)),
+        "next_image": rng.integers(0, 255, (n, IMG, IMG, 3), np.uint8),
+    }
+
+  def test_done_masks_bootstrap_and_clip(self):
+    _, _, updater = self._updater()
+    batch = self._batch(4, reward=[1, 1, 0, 0], done=[1, 1, 0, 0])
+    targets, q_next = updater.compute_targets(batch)
+    # done=1: target == clipped reward exactly, bootstrap masked out.
+    np.testing.assert_allclose(targets[:2], [1.0, 1.0], atol=1e-6)
+    # done=0: target == gamma * q_next (reward 0), in [0, 1].
+    np.testing.assert_allclose(targets[2:], 0.8 * q_next[2:], atol=1e-6)
+    assert np.all(targets >= 0) and np.all(targets <= 1)
+
+  def test_fixed_seeds_make_targets_deterministic(self):
+    _, _, updater = self._updater()
+    batch = self._batch()
+    seeds = np.arange(4, dtype=np.uint32)
+    t1, _ = updater.compute_targets(batch, seeds=seeds)
+    t2, _ = updater.compute_targets(batch, seeds=seeds)
+    np.testing.assert_array_equal(t1, t2)
+
+  def test_refresh_swaps_variables_without_recompiling(self):
+    model, variables, updater = self._updater()
+    batch = self._batch()
+    updater.compute_targets(batch)
+    updater.td_errors(variables, batch, np.zeros(4, np.float32))
+    before = dict(updater.compile_counts)
+    import jax
+    bumped = jax.tree_util.tree_map(lambda x: x + 0.05, variables)
+    updater.refresh(bumped, step=10)
+    updater.compute_targets(batch)
+    updater.td_errors(bumped, batch, np.zeros(4, np.float32))
+    assert updater.compile_counts == before
+    assert all(v == 1 for v in updater.compile_counts.values())
+    assert updater.target_lag(25) == 15
+
+  def test_polyak_refresh_interpolates(self):
+    _, variables, updater = self._updater(polyak_tau=0.25)
+    import jax
+    ones = jax.tree_util.tree_map(np.ones_like, variables)
+    updater.refresh(ones, step=1)
+    leaf_before = jax.tree_util.tree_leaves(variables)[0]
+    leaf_after = jax.tree_util.tree_leaves(
+        updater._target_variables)[0]
+    np.testing.assert_allclose(
+        np.asarray(leaf_after),
+        0.25 * 1.0 + 0.75 * np.asarray(leaf_before), atol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def smoke_results(tmp_path_factory):
+  """ONE full off-policy smoke shared by the acceptance assertions."""
+  from tensor2robot_tpu.bin import run_qtopt_replay
+  logdir = str(tmp_path_factory.mktemp("replay_smoke"))
+  return run_qtopt_replay.run(steps=300, smoke=True, logdir=logdir,
+                              seed=0), logdir
+
+
+class TestOffPolicySmoke:
+  """ISSUE 3 acceptance: tiny critic, purely off-policy, >= 30% eval
+  TD reduction, one-executable ledger, metrics through metric_writer."""
+
+  def test_td_error_reduction_meets_bar(self, smoke_results):
+    results, _ = smoke_results
+    assert results["eval_td_reduction"] >= 0.30, results["eval_history"]
+    assert (results["final_eval"]["eval_q_loss"]
+            < results["initial_eval"]["eval_q_loss"])
+
+  def test_recompile_ledger_exactly_one_train_step(self, smoke_results):
+    results, _ = smoke_results
+    ledger = results["compile_counts"]
+    assert ledger["train_step"] == 1
+    assert ledger["bellman_targets"] == 1
+    assert ledger["bellman_td_error"] == 1
+    # One CEM executable per collector bucket, and every entry is 1.
+    assert any(k.startswith("cem_bucket_") for k in ledger)
+    assert all(v == 1 for v in ledger.values()), ledger
+
+  def test_loop_actually_ran_off_policy(self, smoke_results):
+    results, _ = smoke_results
+    assert results["episodes_collected"] > 50
+    assert results["param_refreshes"] >= 10
+    assert results["buffer"]["replay/fill_fraction"] == 1.0
+    stats = results["queue"]
+    assert stats["enqueued"] == (stats["dropped"] + stats["dequeued"]
+                                 + stats["pending"])
+
+  def test_metrics_flow_through_metric_writer(self, smoke_results):
+    _, logdir = smoke_results
+    path = os.path.join(logdir, "metrics.jsonl")
+    assert os.path.exists(path)
+    seen = set()
+    with open(path) as f:
+      for line in f:
+        seen.update(json.loads(line).keys())
+    for key in ("replay/fill_fraction", "replay/sample_staleness",
+                "replay/drop_rate", "replay/target_lag",
+                "replay/priority_entropy", "replay/eval_td_error",
+                "replay/train_loss"):
+      assert key in seen, (key, sorted(seen))
+
+  def test_cli_emits_one_json_line(self, tmp_path, capsys):
+    """The bin entry's driver contract: ONE parseable JSON line, and
+    --out mirrors it to the artifact file."""
+    from tensor2robot_tpu.bin import run_qtopt_replay
+    out = tmp_path / "replay_smoke.json"
+    run_qtopt_replay.main([
+        "--smoke", "--steps", "40", "--logdir", str(tmp_path / "logs"),
+        "--out", str(out)])
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.strip()]
+    assert len(lines) == 1
+    obj = json.loads(lines[0])
+    assert obj["mode"] == "smoke" and "eval_td_reduction" in obj
+    assert json.loads(out.read_text()) == obj
