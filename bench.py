@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 6
+ROUND = 7
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -930,6 +930,24 @@ def _bench_serving_compact(trials=3, control_steps=10, image_size=None):
   return out
 
 
+def _bench_learner_compact():
+  """Learner-throughput block for the bench detail (ISSUE 4).
+
+  The device-resident megastep's claim — one donated executable per K
+  optimizer steps instead of four dispatches + host replay work per
+  step — is a DRIVER-refreshable measurement, same rationale as the
+  serving block: the full loop artifact (REPLAY_SMOKE_r0N.json) is
+  chipless and builder-committed, but a driver-only chip window should
+  still re-measure the fused-vs-host learner ratio on the real chip.
+  Runs replay/learner_bench's collector-free comparison (TinyQ critic,
+  both paths at ONE batch shape, single-device mesh per-chip basis);
+  every citable field carries the {median,min,max,trials} spread.
+  """
+  from tensor2robot_tpu.replay.learner_bench import (
+      measure_learner_throughput)
+  return measure_learner_throughput()
+
+
 def main() -> None:
   from tensor2robot_tpu.research.qtopt.t2r_models import QTOptGraspingModel
 
@@ -1043,6 +1061,11 @@ def main() -> None:
   except Exception as e:
     serving = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    learner = _bench_learner_compact()
+  except Exception as e:
+    learner = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1097,6 +1120,7 @@ def main() -> None:
       "variants": variants,
       "input_pipeline": input_pipeline,
       "serving": serving,
+      "learner": learner,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1114,6 +1138,8 @@ def main() -> None:
       "record_fed_uint8_steps_per_sec": input_pipeline.get(
           "record_fed_uint8", {}).get(
               "cold_steps_per_sec", {}).get("median"),
+      "learner_megastep_speedup": learner.get(
+          "speedup", {}).get("median"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,
   }))

@@ -20,6 +20,10 @@
 #     with round + UTC time) so any session can `tail` the same file.
 #
 # Stages (artifact -> producer):
+#   REPLAY_SMOKE_r0N.json        bin/run_qtopt_replay --smoke
+#                                --device-resident (CHIPLESS backstop,
+#                                runs before any chip appears; normally
+#                                builder-committed and skipped — ISSUE 4)
 #   BENCH_DETAIL_r0N.json        bench.py (orchestrator; also emits the
 #                                compact line, saved to BENCH_builder_r0N.json)
 #   SERVING_r0N.json             bin/bench_serving single-robot + --fleet lines
@@ -81,7 +85,30 @@ run_stage() {
 }
 
 log "watcher armed (poll ${POLL_S}s, probe bound ${PROBE_TIMEOUT_S}s, max ${MAX_HOURS}h)"
+
+# Chipless backstop BEFORE the chip loop: the replay smoke needs no
+# chip (the CLI pins JAX_PLATFORMS=cpu), so a round whose builder
+# forgot to commit it still gets the artifact. run_stage's tmp→mv is
+# what makes the pickup atomic: a killed run never leaves a truncated
+# artifact that later watchers would skip as landed (ISSUE 4). The
+# skip check runs FIRST (the normal, builder-committed case must not
+# wait on anything), and the pytest defer — the smoke's learner-
+# throughput block is a timing measurement, same contention rule as
+# the probe — is BOUNDED so a test-heavy session can never stall the
+# watcher past its MAX_HOURS contract.
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+if [ -s "REPLAY_SMOKE_${RTAG}.json" ]; then
+  log "skip REPLAY_SMOKE_${RTAG}.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring replay-smoke backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "REPLAY_SMOKE_${RTAG}.json" 1800 sh -c '
+    python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke \
+      --device-resident --out "$STAGE_TMP"'
+fi
 while [ "$(date +%s)" -lt "$deadline" ]; do
   # Never perturb a live test run: the probe's jax import is real CPU
   # on a small host, and the serving smoke's amortization bar is a

@@ -8,14 +8,26 @@ Bellman targets against a lagged target net, and the Trainer's AOT
 train step — with the compiled-program ledger in the output.
 
     python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke
+    python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke --device-resident
+
+`--device-resident` (ISSUE 4) keeps replay state on device and fuses
+K = megastep_inner sample→CEM-label→train→reprioritize iterations into
+ONE donated megastep executable (replay/device_buffer.py); the default
+is the PR 2 host-path loop, kept as the fallback. With
+`--device-resident` the output additionally carries a
+`learner_throughput` block (train steps/s, transitions/s, host-blocked
+fraction, device-vs-host speedup at the same batch shape — the
+replay/learner_bench.py comparison; skip with `--no-learner-bench`).
 
 Prints ONE JSON line (the repo's bench/driver contract): initial/final
 eval Bellman residual, the reduction fraction, replay health counters,
 and `compile_counts` (every value must be 1 — fixed-shape sampling
-never recompiles). `--smoke` is the chipless CI scale (tier-1 asserts
-a >= 30% residual reduction on it); the default scale is the same loop
-with a bigger buffer/budget for on-chip runs. `--out` additionally
-writes the same JSON to a file (the committed smoke artifact).
+never recompiles; on the device path that includes exactly one
+megastep executable). `--smoke` is the chipless CI scale (tier-1
+asserts a >= 30% residual reduction on it); the default scale is the
+same loop with a bigger buffer/budget for on-chip runs. `--out`
+additionally writes the same JSON to a file (the committed smoke
+artifact, REPLAY_SMOKE_r07.json for this round).
 """
 
 from __future__ import annotations
@@ -26,21 +38,24 @@ import os
 import tempfile
 
 
-def build_config(smoke: bool, seed: int):
+def build_config(smoke: bool, seed: int, device_resident: bool = False):
   from tensor2robot_tpu.replay.loop import ReplayLoopConfig
   if smoke:
-    return ReplayLoopConfig(seed=seed)  # the CI-scale defaults
+    return ReplayLoopConfig(seed=seed, device_resident=device_resident)
   return ReplayLoopConfig(
       image_size=64, batch_size=32, capacity=50_000, min_fill=2_000,
       num_buffer_shards=4, num_collectors=4, envs_per_collector=8,
       queue_capacity=10_000, cem_num_samples=64, cem_num_elites=6,
       cem_iterations=3, refresh_every=200, eval_every=500,
-      eval_batches=8, log_every=50, learning_rate=1e-4, seed=seed)
+      eval_batches=8, log_every=50, learning_rate=1e-4, seed=seed,
+      device_resident=device_resident, megastep_inner=50,
+      ingest_chunk=256)
 
 
-def run(steps: int, smoke: bool, logdir: str, seed: int) -> dict:
+def run(steps: int, smoke: bool, logdir: str, seed: int,
+        device_resident: bool = False, learner_bench: bool = True) -> dict:
   from tensor2robot_tpu.replay.loop import ReplayTrainLoop
-  config = build_config(smoke, seed)
+  config = build_config(smoke, seed, device_resident)
   model = None  # default: the flagship QTOptGraspingModel
   if smoke:
     # CI-scale critic (replay/smoke.py): the flagship's conv tower
@@ -53,6 +68,21 @@ def run(steps: int, smoke: bool, logdir: str, seed: int) -> dict:
         optimizer_fn=lambda: optax.adam(config.learning_rate))
   loop = ReplayTrainLoop(config, logdir, model=model)
   results = loop.run(steps)
+  if device_resident and learner_bench:
+    # The ISSUE 4 acceptance block: device-vs-host learner throughput
+    # at the same batch shape (collector-free; replay/learner_bench).
+    from tensor2robot_tpu.replay.learner_bench import (
+        measure_learner_throughput)
+    results["learner_throughput"] = measure_learner_throughput(
+        batch_size=config.batch_size,
+        image_size=config.image_size if smoke else 16,
+        action_size=config.action_size,
+        inner_steps=config.megastep_inner if smoke else 10,
+        steps_per_trial=3 * (config.megastep_inner if smoke else 10),
+        cem_num_samples=config.cem_num_samples,
+        cem_num_elites=config.cem_num_elites,
+        cem_iterations=config.cem_iterations,
+        gamma=config.gamma, seed=seed)
   results["mode"] = "smoke" if smoke else "full"
   results["metric"] = ("QT-Opt off-policy replay loop: eval Bellman "
                        "residual reduction")
@@ -65,6 +95,12 @@ def main(argv=None) -> None:
                       help="optimizer steps (0 = mode default)")
   parser.add_argument("--smoke", action="store_true",
                       help="chipless CI scale on the CPU backend")
+  parser.add_argument("--device-resident", action="store_true",
+                      help="device-resident replay + fused megastep "
+                           "learner (numpy host path is the default)")
+  parser.add_argument("--no-learner-bench", action="store_true",
+                      help="skip the learner_throughput comparison "
+                           "block on --device-resident runs")
   parser.add_argument("--logdir", default=None,
                       help="metric_writer logdir (default: a tempdir)")
   parser.add_argument("--seed", type=int, default=0)
@@ -77,7 +113,9 @@ def main(argv=None) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
   steps = args.steps or (300 if args.smoke else 10_000)
   logdir = args.logdir or tempfile.mkdtemp(prefix="qtopt_replay_")
-  results = run(steps, args.smoke, logdir, args.seed)
+  results = run(steps, args.smoke, logdir, args.seed,
+                device_resident=args.device_resident,
+                learner_bench=not args.no_learner_bench)
   line = json.dumps(results)
   if args.out:
     with open(args.out, "w") as f:

@@ -17,6 +17,11 @@ set, host-RAM replay:
 - ``BellmanUpdater`` (bellman.py): lagged/polyak target network,
   CEM-maximized Q-targets (reward + gamma * max_a' Q_target), AOT at
   the fixed batch shape with a compile-count ledger;
+- ``DeviceReplayBuffer`` / ``MegastepLearner`` (device_buffer.py,
+  ISSUE 4): the same ring as a device-resident pytree with pure
+  jittable extend/sample/reprioritize, plus the fused Anakin-style
+  megastep — K sample -> CEM-label -> train -> reprioritize iterations
+  in ONE donated AOT executable (``ReplayLoopConfig.device_resident``);
 - ``ReplayTrainLoop`` (loop.py): async collect -> replay -> train
   driver wiring serving's CEMFleetPolicy collectors, the buffer, the
   updater, and train/trainer.py together, with replay-health metrics
@@ -26,6 +31,9 @@ Entry point: ``python -m tensor2robot_tpu.bin.run_qtopt_replay``.
 """
 
 from tensor2robot_tpu.replay.bellman import BellmanUpdater
+from tensor2robot_tpu.replay.device_buffer import (DeviceReplayBuffer,
+                                                   DeviceReplayState,
+                                                   MegastepLearner)
 from tensor2robot_tpu.replay.ingest import (ReplayFeeder, TransitionQueue,
                                             episode_to_transitions)
 from tensor2robot_tpu.replay.loop import (CollectorWorker, ReplayLoopConfig,
@@ -37,6 +45,9 @@ from tensor2robot_tpu.replay.sum_tree import SumTree
 __all__ = [
     "BellmanUpdater",
     "CollectorWorker",
+    "DeviceReplayBuffer",
+    "DeviceReplayState",
+    "MegastepLearner",
     "ReplayBuffer",
     "ReplayFeeder",
     "ReplayLoopConfig",

@@ -38,7 +38,80 @@ import numpy as np
 from tensor2robot_tpu.research.qtopt import cem
 
 
-class BellmanUpdater:
+def q_value_from_logits(logits: jnp.ndarray,
+                        clip_targets: bool) -> jnp.ndarray:
+  """Logit → value space (mirrors CriticModel.q_value on arrays)."""
+  logits = logits.astype(jnp.float32)
+  return jax.nn.sigmoid(logits) if clip_targets else logits
+
+
+def make_bellman_targets_fn(model, action_size: int, gamma: float,
+                            num_samples: int, num_elites: int,
+                            iterations: int, clip_targets: bool):
+  """THE Bellman target body, as one pure jittable closure.
+
+  (target_variables, next_images, rewards, dones, keys) ->
+  (targets, q_next): CEM-maximized ``r + gamma * (1 - done) * max_a'
+  Q_target(s', a')`` through the serving score contract
+  (make_tiled_q_score_fn / fleet_cem_optimize). Both the host
+  ``BellmanUpdater`` and the fused megastep
+  (replay/device_buffer.MegastepLearner) compile THIS function — the
+  target recipe cannot silently diverge between the two learners, the
+  exact failure mode the tiled-score contract exists to prevent.
+  """
+  def targets_fn(target_variables, next_images, rewards, dones, keys):
+    score = cem.make_tiled_q_score_fn(model.predict_fn, target_variables)
+    _, best_logits = cem.fleet_cem_optimize(
+        score, next_images, keys, action_size,
+        num_samples=num_samples, num_elites=num_elites,
+        iterations=iterations)
+    q_next = q_value_from_logits(best_logits, clip_targets)
+    targets = (rewards.astype(jnp.float32)
+               + gamma * (1.0 - dones.astype(jnp.float32)) * q_next)
+    if clip_targets:
+      targets = jnp.clip(targets, 0.0, 1.0)
+    return targets, q_next
+
+  return targets_fn
+
+
+class TargetNetwork:
+  """Target-net lifecycle shared by the host and device learners:
+  hard-lag or polyak refresh (a pure array swap — consumers take the
+  target as an executable ARGUMENT, so refresh never recompiles),
+  plus the lag/refresh-count health metrics."""
+
+  def __init__(self, variables=None, polyak_tau: Optional[float] = None):
+    self._polyak_tau = polyak_tau
+    self._target_variables = (
+        None if variables is None
+        else jax.tree_util.tree_map(jnp.copy, variables))
+    self._refresh_count = 0
+    self.last_refresh_step = 0
+
+  def refresh(self, variables, step: int) -> None:
+    """Pulls the online variables into the target net (lag or polyak;
+    the first refresh of a cold target is always a hard copy)."""
+    if self._polyak_tau is None or self._target_variables is None:
+      self._target_variables = jax.tree_util.tree_map(jnp.copy, variables)
+    else:
+      tau = self._polyak_tau
+      self._target_variables = jax.tree_util.tree_map(
+          lambda online, target: tau * online + (1.0 - tau) * target,
+          variables, self._target_variables)
+    self._refresh_count += 1
+    self.last_refresh_step = int(step)
+
+  def target_lag(self, step: int) -> int:
+    """Optimizer steps since the target net last saw online params."""
+    return int(step) - self.last_refresh_step
+
+  @property
+  def refresh_count(self) -> int:
+    return self._refresh_count
+
+
+class BellmanUpdater(TargetNetwork):
   """Q-target labeller over a critic model with a ``q_predicted`` head."""
 
   def __init__(
@@ -67,6 +140,7 @@ class BellmanUpdater:
       polyak_tau: None = hard copy on refresh(); else
         target <- tau * online + (1 - tau) * target per refresh call.
     """
+    super().__init__(variables, polyak_tau=polyak_tau)
     self._model = model
     self._action_size = action_size
     self._gamma = gamma
@@ -74,12 +148,8 @@ class BellmanUpdater:
     self._num_elites = num_elites
     self._iterations = iterations
     self._seed = seed
-    self._polyak_tau = polyak_tau
     self._clip_targets = getattr(model, "loss_type",
                                  "cross_entropy") == "cross_entropy"
-    self._target_variables = jax.tree_util.tree_map(jnp.copy, variables)
-    self._refresh_count = 0
-    self.last_refresh_step = 0
     # fn name -> number of XLA compiles; the replay smoke asserts every
     # value is exactly 1 (fixed-shape sampling never recompiles).
     self.compile_counts: Dict[str, int] = {}
@@ -87,62 +157,27 @@ class BellmanUpdater:
     self._td_exec = None
     self._next_label_seed = 0
 
-  # --- target network ------------------------------------------------------
-
-  def refresh(self, variables, step: int) -> None:
-    """Pulls the online variables into the target net (lag or polyak)."""
-    if self._polyak_tau is None:
-      self._target_variables = jax.tree_util.tree_map(jnp.copy, variables)
-    else:
-      tau = self._polyak_tau
-      self._target_variables = jax.tree_util.tree_map(
-          lambda online, target: tau * online + (1.0 - tau) * target,
-          variables, self._target_variables)
-    self._refresh_count += 1
-    self.last_refresh_step = int(step)
-
-  def target_lag(self, step: int) -> int:
-    """Optimizer steps since the target net last saw online params."""
-    return int(step) - self.last_refresh_step
-
-  @property
-  def refresh_count(self) -> int:
-    return self._refresh_count
-
   # --- compiled computations ----------------------------------------------
 
   def _q_value(self, logits: jnp.ndarray) -> jnp.ndarray:
-    """Logit → value space (mirrors CriticModel.q_value on arrays)."""
-    logits = logits.astype(jnp.float32)
-    return jax.nn.sigmoid(logits) if self._clip_targets else logits
+    return q_value_from_logits(logits, self._clip_targets)
 
   def _build_targets_fn(self):
-    model, action_size = self._model, self._action_size
-    gamma, seed = self._gamma, self._seed
-    num_samples, num_elites = self._num_samples, self._num_elites
-    iterations, clip = self._iterations, self._clip_targets
+    seed = self._seed
+    # The shared pure target body (also compiled by the megastep): the
+    # updater only adds its uint32-counter → key fold in front.
+    targets_fn = make_bellman_targets_fn(
+        self._model, self._action_size, self._gamma, self._num_samples,
+        self._num_elites, self._iterations, self._clip_targets)
 
-    def targets_fn(target_variables, next_images, rewards, dones, seeds):
+    def seeded_targets_fn(target_variables, next_images, rewards, dones,
+                          seeds):
       base = jax.random.key(seed)
       keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(seeds)
+      return targets_fn(target_variables, next_images, rewards, dones,
+                        keys)
 
-      # The SAME tiled score the fleet serving policy uses: actions
-      # served and actions that label targets go through one contract.
-      score = cem.make_tiled_q_score_fn(model.predict_fn,
-                                        target_variables)
-
-      _, best_logits = cem.fleet_cem_optimize(
-          score, next_images, keys, action_size,
-          num_samples=num_samples, num_elites=num_elites,
-          iterations=iterations)
-      q_next = self._q_value(best_logits)
-      targets = (rewards.astype(jnp.float32)
-                 + gamma * (1.0 - dones.astype(jnp.float32)) * q_next)
-      if clip:
-        targets = jnp.clip(targets, 0.0, 1.0)
-      return targets, q_next
-
-    return targets_fn
+    return seeded_targets_fn
 
   def _build_td_fn(self):
     model = self._model

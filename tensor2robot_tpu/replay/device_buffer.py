@@ -1,0 +1,696 @@
+"""Device-resident replay + fused Bellman/train megastep (Anakin-style).
+
+ISSUE 4: PR 2's loop kept replay state in host numpy, so every optimizer
+step paid host sample → H2D → compiled step → D2H priority write-back —
+the chip serialized behind the host four dispatches per step. Podracer
+(PAPERS.md, arXiv:2104.06272) keeps the ENTIRE learner hot path device-
+resident: replay storage, the sum tree, sampling RNG, Bellman targets,
+the optimizer step, and the priority write-back all live in one compiled
+program, and the host's only jobs are feeding fresh transitions and
+reading metrics. The pjit/TPUv4 scaling study (arXiv:2204.06514) adds
+the discipline that makes it stick: donated buffers and fixed shapes so
+XLA updates HBM in place and never re-stages.
+
+Two layers here:
+
+- ``DeviceReplayBuffer``: the replay ring as a pytree of device arrays
+  (``DeviceReplayState``) plus PURE jittable functions — fixed-chunk
+  extend, seeded uniform/prioritized sampling, priority updates — with
+  the same flat-spec layout, capacity semantics, and (|td| + eps)^alpha
+  priority shaping as ``ring_buffer.ReplayBuffer``. Storage shards over
+  the capacity axis via the existing mesh rules
+  (``parallel.mesh.batch_sharding``) when capacity divides the data
+  axis. The sum tree is a device float32 array in the same
+  complete-binary-heap layout as ``sum_tree.SumTree``; parents are
+  fully RECOMPUTED level-by-level on every update (static slices, no
+  drift), and sampling is the same vectorized root-to-leaf descent.
+- ``MegastepLearner``: ONE donated, AOT-compiled executable that runs K
+  inner iterations via ``lax.scan`` — on-device RNG sample →
+  CEM-maximized Bellman targets (the SAME ``cem.fleet_cem_optimize`` /
+  ``make_tiled_q_score_fn`` contract serving and the host
+  ``BellmanUpdater`` use) → the Trainer's grad/apply body
+  (``Trainer.train_step_fn``) → in-place priority update. The target
+  network is an ARGUMENT of the executable (refresh swaps arrays, never
+  recompiles), and ``compile_counts`` extends the replay ledger:
+  exactly one megastep executable for the life of the learner.
+
+Determinism contract: sampling randomness is a pure function of
+(buffer seed, outer step, inner step) and CEM label randomness of
+(label seed counter), independent of batch composition — the same
+fold-in discipline the fleet server and host updater hold.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.replay.bellman import (TargetNetwork,
+                                             make_bellman_targets_fn,
+                                             q_value_from_logits)
+from tensor2robot_tpu.replay.ring_buffer import (SampleInfo,
+                                                 _validate_against_spec)
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+class DeviceReplayState(flax.struct.PyTreeNode):
+  """The replay ring as one donated pytree of device arrays.
+
+  storage: one (capacity, *spec.shape) array per flat spec key.
+  written_at: append index at which each slot was last written
+    (staleness metric, int32 — the device mirror of the host ring's
+    ``_written_at``).
+  next_slot / size / append_count: scalar int32 ring bookkeeping.
+  tree: (2 * n_leaves,) float32 sum tree (heap layout; root at [1]);
+    a (2,) zero placeholder for uniform buffers so the pytree
+    structure is mode-independent.
+  max_priority: scalar float32 — fresh appends enter at this priority
+    (unseen experience outranks everything until its first TD error).
+  """
+  storage: Dict[str, jnp.ndarray]
+  written_at: jnp.ndarray
+  next_slot: jnp.ndarray
+  size: jnp.ndarray
+  append_count: jnp.ndarray
+  tree: jnp.ndarray
+  max_priority: jnp.ndarray
+
+
+# --- device sum tree (pure, static-shape) ----------------------------------
+
+
+def tree_refresh_parents(tree: jnp.ndarray, depth: int) -> jnp.ndarray:
+  """Recomputes EVERY internal node from its children, bottom-up.
+
+  O(2n) adds per call via static slices — at replay capacities this is
+  microseconds, and full recomputation (the host SumTree's
+  renormalization property) means float drift cannot accumulate over
+  millions of updates.
+  """
+  for level in range(depth - 1, -1, -1):
+    start = 1 << level
+    children = jax.lax.dynamic_slice(tree, (2 * start,), (2 * start,))
+    sums = children[0::2] + children[1::2]
+    tree = jax.lax.dynamic_update_slice(tree, sums, (start,))
+  return tree
+
+
+def tree_set(tree: jnp.ndarray, indices: jnp.ndarray, values: jnp.ndarray,
+             depth: int, n_leaves: int) -> jnp.ndarray:
+  """Sets leaf weights and refreshes all ancestors (jittable).
+
+  Callers passing duplicate indices must ensure their values agree
+  (XLA scatter picks an unspecified winner otherwise); the megastep's
+  TD path reduces duplicates FIRST via `tree_set_segment_max`.
+  """
+  tree = tree.at[n_leaves + indices].set(values.astype(jnp.float32))
+  return tree_refresh_parents(tree, depth)
+
+
+def tree_set_segment_max(tree: jnp.ndarray, indices: jnp.ndarray,
+                         values: jnp.ndarray, depth: int, n_leaves: int,
+                         capacity: int) -> jnp.ndarray:
+  """tree_set with DETERMINISTIC duplicate-index resolution (max).
+
+  Sampling with replacement can draw the same buffer slot twice in one
+  batch, and each draw carries its own CEM label key — hence a
+  different target and a different |td|. A raw scatter would leave the
+  winner to XLA's implementation-defined duplicate ordering; reducing
+  duplicates with a commutative max BEFORE the (now duplicate-free)
+  leaf write keeps device priorities a pure function of the inputs on
+  every backend. (The host path's numpy fancy-store resolves
+  duplicates last-wins instead; the two rules only differ when one
+  batch repeats a slot with disagreeing TDs, where no ordering is more
+  "correct" — determinism is the contract, and max errs toward
+  replaying the transition.)
+  """
+  values = values.astype(jnp.float32)
+  reduced = jax.ops.segment_max(values, indices, num_segments=capacity)
+  touched = jax.ops.segment_sum(
+      jnp.ones_like(values), indices, num_segments=capacity) > 0
+  leaves = jax.lax.dynamic_slice(tree, (n_leaves,), (capacity,))
+  tree = jax.lax.dynamic_update_slice(
+      tree, jnp.where(touched, reduced, leaves), (n_leaves,))
+  return tree_refresh_parents(tree, depth)
+
+
+def tree_sample(tree: jnp.ndarray, uniforms: jnp.ndarray, depth: int,
+                n_leaves: int, capacity: int) -> jnp.ndarray:
+  """Proportional sample via vectorized root-to-leaf descent.
+
+  Mirrors sum_tree.SumTree.sample including the float-edge clamp onto
+  real slots; zero-mass picks (or a zero-total tree) must be remapped
+  by the caller exactly as ReplayBuffer.sample does.
+  """
+  mass = uniforms.astype(jnp.float32) * tree[1]
+  pos = jnp.ones(uniforms.shape, jnp.int32)
+  for _ in range(depth):
+    left = 2 * pos
+    left_mass = tree[left]
+    go_right = mass >= left_mass
+    mass = jnp.where(go_right, mass - left_mass, mass)
+    pos = jnp.where(go_right, left + 1, left)
+  return jnp.minimum(pos - n_leaves, capacity - 1)
+
+
+class DeviceReplayBuffer:
+  """Host handle for a device-resident replay ring.
+
+  Mirrors ``ReplayBuffer``'s constructor contract (flat-spec storage,
+  honest capacity, ONE fixed sample batch shape, seeded sampling,
+  (|td| + eps)^alpha priorities) while keeping all state on device.
+  The pure functions (``extend_fn`` / ``sample_fn`` /
+  ``update_priorities_fn``) are what ``MegastepLearner`` inlines into
+  its fused executable; the host-facing ``extend`` / ``sample`` /
+  ``update_priorities`` methods wrap the same functions behind
+  per-function AOT executables (ledger in ``compile_counts``) so tests
+  can drive the buffer exactly like the numpy ring.
+
+  Host extend is CHUNKED at one fixed shape (``ingest_chunk``): fresh
+  transitions accumulate in a host-side pending list and flush to the
+  device in fixed quanta, so the extend executable compiles exactly
+  once (the fixed-shape discipline every compiled program here holds).
+  """
+
+  def __init__(
+      self,
+      transition_spec: ts.SpecStructure,
+      capacity: int,
+      sample_batch_size: int,
+      seed: int = 0,
+      prioritized: bool = False,
+      priority_exponent: float = 0.6,
+      min_priority: float = 1e-3,
+      ingest_chunk: int = 64,
+      mesh: Optional[jax.sharding.Mesh] = None,
+      data_axis: str = "data",
+  ):
+    if capacity < 1:
+      raise ValueError(f"capacity must be >= 1, got {capacity}")
+    if sample_batch_size < 1:
+      raise ValueError(
+          f"sample_batch_size must be >= 1, got {sample_batch_size}")
+    ingest_chunk = min(ingest_chunk, capacity)
+    self._spec = ts.flatten_spec_structure(transition_spec)
+    if not list(self._spec.keys()):
+      raise ValueError("transition_spec has no leaves")
+    self.capacity = capacity
+    self.sample_batch_size = sample_batch_size
+    self.ingest_chunk = ingest_chunk
+    self._prioritized = prioritized
+    self._alpha = priority_exponent
+    self._min_priority = min_priority
+    self._depth = max(1, int(np.ceil(np.log2(capacity))))
+    self._n_leaves = 1 << self._depth
+    self._seed = seed
+    self._base_key = jax.random.key(seed)
+    self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
+    self._data_axis = data_axis
+    self._replicated = mesh_lib.replicated_sharding(self.mesh)
+    # Capacity-axis sharding via the EXISTING mesh rule (the batch rule
+    # applied to the (capacity, ...) leading dim). Indivisible
+    # capacities fall back to replication — correct, just unsharded.
+    axis_size = self.mesh.shape[data_axis]
+    self._capacity_sharding = (
+        mesh_lib.batch_sharding(self.mesh, data_axis)
+        if capacity % axis_size == 0 else self._replicated)
+    self._lock = threading.Lock()
+    self._pending: Dict[str, list] = {key: [] for key in self._spec}
+    self._pending_count = 0
+    self._sample_calls = 0
+    # fn name -> number of XLA compiles; tests assert every value is 1.
+    self.compile_counts: Dict[str, int] = {}
+    self._extend_exec = None
+    self._sample_exec = None
+    self._update_exec = None
+    self._state = self._init_state()
+
+  # --- state construction --------------------------------------------------
+
+  def _init_state(self) -> DeviceReplayState:
+    storage = {
+        key: jnp.zeros((self.capacity,) + tuple(spec.shape),
+                       jnp.dtype(spec.dtype))
+        for key, spec in self._spec.items()
+    }
+    tree_len = 2 * self._n_leaves if self._prioritized else 2
+    state = DeviceReplayState(
+        storage=storage,
+        written_at=jnp.zeros((self.capacity,), jnp.int32),
+        next_slot=jnp.zeros((), jnp.int32),
+        size=jnp.zeros((), jnp.int32),
+        append_count=jnp.zeros((), jnp.int32),
+        tree=jnp.zeros((tree_len,), jnp.float32),
+        max_priority=jnp.ones((), jnp.float32),
+    )
+    return jax.device_put(state, self.state_shardings())
+
+  def state_shardings(self):
+    """Sharding pytree for DeviceReplayState: capacity-axis arrays over
+    the data axis, scalars + tree replicated (the tree's heap layout
+    has no capacity-aligned axis to split)."""
+    return DeviceReplayState(
+        storage={key: self._capacity_sharding for key in self._spec},
+        written_at=self._capacity_sharding,
+        next_slot=self._replicated,
+        size=self._replicated,
+        append_count=self._replicated,
+        tree=self._replicated,
+        max_priority=self._replicated,
+    )
+
+  @property
+  def state(self) -> DeviceReplayState:
+    """The current device pytree (megastep consumers thread this)."""
+    return self._state
+
+  def set_state(self, state: DeviceReplayState) -> None:
+    """Installs the state returned by a donating executable (the old
+    pytree's buffers are dead after donation)."""
+    self._state = state
+
+  # --- pure jittable functions --------------------------------------------
+
+  def extend_fn(self) -> Callable:
+    """(state, {key: (chunk, *shape)}) -> state; fixed-chunk ring write.
+
+    Wraparound via modular scatter indices; fresh slots enter the tree
+    at the current max priority (ReplayBuffer.append parity). The chunk
+    size is bounded by capacity at construction, so scatter positions
+    within one call are unique.
+    """
+    capacity, chunk = self.capacity, self.ingest_chunk
+    prioritized = self._prioritized
+    depth, n_leaves = self._depth, self._n_leaves
+
+    def extend(state: DeviceReplayState,
+               batch: Dict[str, jnp.ndarray]) -> DeviceReplayState:
+      offsets = jnp.arange(chunk, dtype=jnp.int32)
+      positions = (state.next_slot + offsets) % capacity
+      storage = {
+          key: state.storage[key].at[positions].set(
+              batch[key].astype(state.storage[key].dtype))
+          for key in state.storage
+      }
+      written_at = state.written_at.at[positions].set(
+          state.append_count + offsets)
+      tree = state.tree
+      if prioritized:
+        tree = tree_set(
+            tree, positions,
+            jnp.full((chunk,), 1.0, jnp.float32) * state.max_priority,
+            depth, n_leaves)
+      return state.replace(
+          storage=storage,
+          written_at=written_at,
+          next_slot=(state.next_slot + chunk) % capacity,
+          size=jnp.minimum(state.size + chunk, capacity),
+          append_count=state.append_count + chunk,
+          tree=tree)
+
+    return extend
+
+  def sample_fn(self) -> Callable:
+    """(state, key) -> (batch, indices, probabilities, staleness).
+
+    Seeded uniform or sum-tree prioritized at THE fixed batch shape.
+    Prioritized zero-mass picks (float-edge descents, unwritten clamp
+    slots) remap uniformly onto the filled prefix with the remap
+    probability reported — ReplayBuffer.sample parity, so importance
+    weights correct for the true distribution on both paths.
+    Probabilities are float32 (the normalized dtype contract at this
+    boundary — the host path emits the same).
+    """
+    n = self.sample_batch_size
+    capacity = self.capacity
+    prioritized = self._prioritized
+    depth, n_leaves = self._depth, self._n_leaves
+
+    def sample(state: DeviceReplayState, key: jax.Array):
+      size = jnp.maximum(state.size, 1)
+      uniform_key, remap_key = jax.random.split(key)
+      uniform_idx = jax.random.randint(uniform_key, (n,), 0, size,
+                                       dtype=jnp.int32)
+      if prioritized:
+        uniforms = jax.random.uniform(remap_key, (n,), jnp.float32)
+        idx = tree_sample(state.tree, uniforms, depth, n_leaves,
+                          capacity)
+        leaf = state.tree[n_leaves + idx]
+        total = jnp.maximum(state.tree[1], jnp.float32(1e-30))
+        zero = leaf <= 0.0
+        indices = jnp.where(zero, uniform_idx, idx)
+        probabilities = jnp.where(
+            zero, 1.0 / size.astype(jnp.float32), leaf / total)
+      else:
+        indices = uniform_idx
+        probabilities = jnp.full((n,), 1.0, jnp.float32) / size
+      batch = {key_: state.storage[key_][indices]
+               for key_ in state.storage}
+      staleness = state.append_count - state.written_at[indices]
+      return batch, indices, probabilities.astype(jnp.float32), staleness
+
+    return sample
+
+  def update_priorities_fn(self) -> Callable:
+    """(state, indices, td_errors) -> state; (|td| + eps)^alpha refresh.
+
+    TD errors are float32 at this boundary (the normalized dtype the
+    host path now also holds); no-op for uniform buffers. Duplicate
+    indices (sampling with replacement) reduce deterministically —
+    see `tree_set_segment_max`.
+    """
+    if not self._prioritized:
+      return lambda state, indices, td_errors: state
+    alpha, eps = self._alpha, self._min_priority
+    depth, n_leaves = self._depth, self._n_leaves
+    capacity = self.capacity
+
+    def update(state: DeviceReplayState, indices: jnp.ndarray,
+               td_errors: jnp.ndarray) -> DeviceReplayState:
+      td = jnp.abs(td_errors.astype(jnp.float32)).reshape(-1)
+      priorities = (td + eps) ** alpha
+      return state.replace(
+          tree=tree_set_segment_max(state.tree, indices.reshape(-1),
+                                    priorities, depth, n_leaves,
+                                    capacity),
+          max_priority=jnp.maximum(state.max_priority,
+                                   priorities.max()))
+
+    return update
+
+  # --- host-facing API (ReplayBuffer drop-in surface) ----------------------
+
+  def _compile(self, name: str, fn, args, donate=()):
+    """AOT lower+compile (the repo's recompile-ledger idiom): the
+    executable rejects any later shape drift instead of retracing."""
+    executable = jax.jit(fn, donate_argnums=donate).lower(*args).compile()
+    self.compile_counts[name] = self.compile_counts.get(name, 0) + 1
+    return executable
+
+  def append(self, transition) -> int:
+    """Validates + stages one transition; returns 1 (accepted count)."""
+    arrays = _validate_against_spec(self._spec, transition, batched=False)
+    return self.extend({key: array[None] for key, array in arrays.items()},
+                       _validated=True)
+
+  def extend(self, transitions, _validated: bool = False) -> int:
+    """Validates + stages a batch; flushes full fixed-size chunks.
+
+    Returns the number of transitions accepted (all of them — partial
+    chunks wait host-side in ``pending`` until enough accumulate, so
+    the device extend executable only ever sees ONE shape).
+    """
+    arrays = (dict(transitions) if _validated else
+              _validate_against_spec(self._spec, transitions, batched=True))
+    n = next(iter(arrays.values())).shape[0]
+    with self._lock:
+      for key, array in arrays.items():
+        self._pending[key].append(np.asarray(array))
+      self._pending_count += n
+      while self._pending_count >= self.ingest_chunk:
+        self._flush_chunk_locked()
+    return n
+
+  def _flush_chunk_locked(self) -> None:
+    chunk = self.ingest_chunk
+    stacked = {}
+    for key, parts in self._pending.items():
+      merged = parts[0] if len(parts) == 1 else np.concatenate(parts)
+      stacked[key] = merged[:chunk]
+      self._pending[key] = [merged[chunk:]] if merged.shape[0] > chunk \
+          else []
+    self._pending_count -= chunk
+    if self._extend_exec is None:
+      self._extend_exec = self._compile(
+          "device_extend", self.extend_fn(), (self._state, stacked),
+          donate=(0,))
+    self._state = self._extend_exec(self._state, stacked)
+
+  def sample(self) -> Tuple[ts.TensorSpecStruct, SampleInfo]:
+    """One fixed-shape batch + SampleInfo, as host numpy (ReplayBuffer
+    drop-in for tests/interop; the megastep inlines sample_fn instead
+    and never round-trips through here)."""
+    with self._lock:
+      if int(jax.device_get(self._state.size)) == 0:
+        raise ValueError("cannot sample from an empty DeviceReplayBuffer")
+      self._sample_calls += 1
+      key = jax.random.fold_in(self._base_key, self._sample_calls)
+      if self._sample_exec is None:
+        self._sample_exec = self._compile(
+            "device_sample", self.sample_fn(), (self._state, key))
+      batch, indices, probabilities, staleness = jax.device_get(
+          self._sample_exec(self._state, key))
+    return (
+        ts.TensorSpecStruct({k: np.asarray(v) for k, v in batch.items()}),
+        SampleInfo(
+            indices=np.asarray(indices, np.int64),
+            staleness=np.asarray(staleness, np.int64),
+            probabilities=np.asarray(probabilities, np.float32)))
+
+  def update_priorities(self, indices, td_errors) -> None:
+    if not self._prioritized:
+      return
+    indices = jnp.asarray(np.asarray(indices).reshape(-1), jnp.int32)
+    td = jnp.asarray(np.asarray(td_errors, np.float32).reshape(-1))
+    with self._lock:
+      # One AOT executable PER update length: the megastep inlines the
+      # pure fn at the fixed batch shape (never through here); this
+      # host surface serves tests/interop, which update arbitrary
+      # index sets — the ledger key carries the length so a fixed-shape
+      # caller still proves "compiled exactly once".
+      n = int(indices.shape[0])
+      if self._update_exec is None:
+        self._update_exec = {}
+      if n not in self._update_exec:
+        self._update_exec[n] = self._compile(
+            f"device_update_priorities_n{n}",
+            self.update_priorities_fn(),
+            (self._state, indices, td), donate=(0,))
+      self._state = self._update_exec[n](self._state, indices, td)
+
+  def priorities(self, indices) -> np.ndarray:
+    """Leaf priorities at `indices` (host float32) — the round-trip
+    read tests pin against (|td| + eps)^alpha."""
+    if not self._prioritized:
+      raise ValueError("uniform DeviceReplayBuffer has no priorities")
+    idx = np.asarray(indices, np.int64).reshape(-1)
+    leaves = np.asarray(jax.device_get(self._state.tree))
+    return leaves[self._n_leaves + idx].astype(np.float32)
+
+  # --- health metrics (ReplayBuffer parity) --------------------------------
+
+  @property
+  def size(self) -> int:
+    return int(jax.device_get(self._state.size))
+
+  @property
+  def append_count(self) -> int:
+    return int(jax.device_get(self._state.append_count))
+
+  @property
+  def pending(self) -> int:
+    """Host-side transitions staged but not yet flushed (sub-chunk)."""
+    with self._lock:
+      return self._pending_count
+
+  @property
+  def fill_fraction(self) -> float:
+    return self.size / self.capacity
+
+  def priority_entropy(self) -> float:
+    """Normalized entropy of the sampling distribution (host-path
+    semantics: 1.0 for uniform buffers and degenerate sizes)."""
+    size = self.size
+    if not self._prioritized or size <= 1:
+      return 1.0
+    leaves = np.asarray(
+        jax.device_get(self._state.tree), np.float64)[
+            self._n_leaves:self._n_leaves + size]
+    total = leaves.sum()
+    if total <= 0:
+      return 1.0
+    p = leaves / total
+    p = p[p > 0]
+    return float(-(p * np.log(p)).sum() / np.log(size))
+
+  def metrics(self) -> Dict[str, float]:
+    return {
+        "replay/fill_fraction": self.fill_fraction,
+        "replay/size": float(self.size),
+        "replay/append_count": float(self.append_count),
+        "replay/priority_entropy": self.priority_entropy(),
+    }
+
+
+class MegastepLearner(TargetNetwork):
+  """K fused sample→label→train→reprioritize iterations per dispatch.
+
+  The Anakin/Podracer learner shape: ONE donated AOT executable whose
+  body is ``lax.scan`` over K inner iterations of
+
+      on-device RNG sample (uniform or sum-tree prioritized)
+      → CEM-maximized Bellman targets against the target net
+        (cem.fleet_cem_optimize via make_tiled_q_score_fn — the same
+        score contract serving and the host BellmanUpdater use)
+      → Trainer grad/apply (Trainer.train_step_fn, the exact body the
+        host path compiles standalone)
+      → TD errors under the FRESH params → in-place priority update.
+
+  The host dispatches once per K optimizer steps and reads back only
+  scalar metrics; the target network and the train/replay states are
+  executable ARGUMENTS, so target refresh (hard or polyak) and param
+  evolution never recompile. ``compile_counts['megastep']`` is asserted
+  == 1 by the replay ledger tests.
+  """
+
+  def __init__(
+      self,
+      model,
+      trainer,
+      buffer: DeviceReplayBuffer,
+      action_size: int = 4,
+      gamma: float = 0.9,
+      num_samples: int = 32,
+      num_elites: int = 4,
+      iterations: int = 2,
+      inner_steps: int = 10,
+      seed: int = 0,
+      polyak_tau: Optional[float] = None,
+  ):
+    if inner_steps < 1:
+      raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
+    # Cold target net: the first refresh() hard-copies regardless of
+    # polyak_tau (TargetNetwork semantics).
+    super().__init__(polyak_tau=polyak_tau)
+    self._model = model
+    self._trainer = trainer
+    self._buffer = buffer
+    self._action_size = action_size
+    self._gamma = gamma
+    self._num_samples = num_samples
+    self._num_elites = num_elites
+    self._iterations = iterations
+    self.inner_steps = inner_steps
+    self._seed = seed
+    self._clip_targets = getattr(model, "loss_type",
+                                 "cross_entropy") == "cross_entropy"
+    self.compile_counts: Dict[str, int] = {}
+    self._exec = None
+    self._outer = 0
+    self._label_seed = 0
+
+  # --- the fused program ---------------------------------------------------
+
+  def _build_megastep_fn(self):
+    model = self._model
+    step_fn = self._trainer.train_step_fn()
+    sample = self._buffer.sample_fn()
+    update_priorities = self._buffer.update_priorities_fn()
+    # THE shared target body (bellman.make_bellman_targets_fn): the
+    # megastep compiles the identical recipe the host updater AOTs.
+    targets_fn = make_bellman_targets_fn(
+        model, self._action_size, self._gamma, self._num_samples,
+        self._num_elites, self._iterations, self._clip_targets)
+    batch_size = self._buffer.sample_batch_size
+    clip = self._clip_targets
+    k = self.inner_steps
+    target_key = getattr(model, "target_key", "target_q")
+    sample_base = jax.random.key(self._seed)
+    label_base = jax.random.key(self._seed + 1)
+
+    def megastep(train_state, buffer_state, target_variables,
+                 outer_step, label_seed0):
+
+      def body(carry, inner):
+        train_state, buffer_state = carry
+        # Sampling randomness: pure function of (seed, outer, inner) —
+        # replayable and independent of batch composition.
+        skey = jax.random.fold_in(
+            sample_base, outer_step * jnp.int32(k) + inner)
+        batch, indices, _, staleness = sample(buffer_state, skey)
+        # CEM label keys: the host updater's monotonic uint32 counter,
+        # continued exactly (one key per labelled transition ever).
+        seeds = (label_seed0 + (inner * batch_size
+                                + jnp.arange(batch_size))).astype(
+                                    jnp.uint32)
+        keys = jax.vmap(
+            lambda s: jax.random.fold_in(label_base, s))(seeds)
+        targets, q_next = targets_fn(
+            target_variables, batch["next_image"], batch["reward"],
+            batch["done"], keys)
+        features = {"image": batch["image"], "action": batch["action"]}
+        train_state, metrics = step_fn(train_state, features,
+                                       {target_key: targets})
+        # TD under the FRESH (post-update) params — host-loop parity:
+        # priorities reflect what the net thinks NOW.
+        outputs = model.predict_fn(
+            train_state.variables(use_ema=True),
+            {"image": batch["image"],
+             "action": batch["action"].astype(jnp.float32)})
+        q = q_value_from_logits(
+            jnp.reshape(outputs["q_predicted"], (-1,)), clip)
+        td = jnp.abs(q - targets)
+        buffer_state = update_priorities(buffer_state, indices, td)
+        inner_metrics = {
+            "loss": metrics["loss"].astype(jnp.float32),
+            "td_error": jnp.mean(td),
+            "q_next": jnp.mean(q_next),
+            "staleness": jnp.mean(staleness.astype(jnp.float32)),
+        }
+        return (train_state, buffer_state), inner_metrics
+
+      (train_state, buffer_state), metrics = jax.lax.scan(
+          body, (train_state, buffer_state),
+          jnp.arange(k, dtype=jnp.int32))
+      # Host-loop convention: report the LAST inner step's metrics.
+      return train_state, buffer_state, jax.tree_util.tree_map(
+          lambda x: x[-1], metrics)
+
+    return megastep
+
+  def compiled(self, train_state):
+    """The megastep executable, AOT-compiled once (ledger: exactly 1).
+
+    Donates (train_state, buffer_state): params, opt state, storage,
+    and the sum tree are updated in place in device memory — the
+    fixed-shape + donation discipline of arXiv:2204.06514 that keeps
+    XLA from re-staging buffers between dispatches.
+    """
+    if self._exec is None:
+      args = (train_state, self._buffer.state, self._target_variables,
+              jnp.zeros((), jnp.int32), jnp.zeros((), jnp.uint32))
+      self._exec = jax.jit(
+          self._build_megastep_fn(),
+          donate_argnums=(0, 1)).lower(*args).compile()
+      self.compile_counts["megastep"] = (
+          self.compile_counts.get("megastep", 0) + 1)
+    return self._exec
+
+  def step(self, train_state):
+    """One dispatch = K optimizer steps. Returns (state', metrics).
+
+    The buffer's state pytree is threaded through the donation and
+    re-installed; metrics come back as host floats (the only D2H of
+    the hot path).
+    """
+    if self._target_variables is None:
+      raise ValueError("call refresh(variables, step=0) before step()")
+    exec_ = self.compiled(train_state)
+    train_state, buffer_state, metrics = exec_(
+        train_state, self._buffer.state,
+        self._target_variables,
+        jnp.asarray(self._outer, jnp.int32),
+        jnp.asarray(self._label_seed, jnp.uint32))
+    self._buffer.set_state(buffer_state)
+    self._outer += 1
+    self._label_seed = (self._label_seed
+                        + self.inner_steps * self._buffer.sample_batch_size
+                        ) % (2 ** 32)
+    return train_state, {key: float(value)
+                         for key, value in jax.device_get(metrics).items()}

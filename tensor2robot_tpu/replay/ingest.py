@@ -120,6 +120,31 @@ class TransitionQueue:
       self.dequeued += n
     return out
 
+  def drain_batch(self, max_items: Optional[int] = None
+                  ) -> Optional[Dict[str, np.ndarray]]:
+    """Pops up to max_items and stacks them into ONE batch per key.
+
+    The buffer-extend path used to copy every leaf twice: drain() built
+    per-transition dicts, then the feeder's per-item appends copied each
+    leaf again into storage (ISSUE 4 satellite). This emits a single
+    stacked array per key — one concatenate — which ReplayBuffer.extend
+    writes with one vectorized slot store. Only the pop runs under the
+    lock; the stacking works on the popped items outside it, so
+    concurrent put() is never blocked behind the copy.
+
+    Returns None when the queue is empty (the per-step drain's common
+    case, kept allocation-free).
+    """
+    with self._lock:
+      n = len(self._items) if max_items is None else min(
+          max_items, len(self._items))
+      items = [self._items.popleft() for _ in range(n)]
+      self.dequeued += n
+    if not items:
+      return None
+    return {key: np.stack([item[key] for item in items])
+            for key in items[0]}
+
   def __len__(self) -> int:
     with self._lock:
       return len(self._items)
@@ -156,11 +181,17 @@ class ReplayFeeder:
     self.min_fill = min_fill
 
   def drain(self) -> int:
-    """Moves every pending transition into the buffer; returns count."""
-    transitions = self.queue.drain()
-    for transition in transitions:
-      self.buffer.append(transition)
-    return len(transitions)
+    """Moves every pending transition into the buffer; returns count.
+
+    One stacked batch through buffer.extend (single concatenate per
+    key + one vectorized ring write) instead of per-item appends —
+    and the same call feeds the device-resident buffer, whose extend
+    stages fixed-shape chunks to the chip.
+    """
+    batch = self.queue.drain_batch()
+    if batch is None:
+      return 0
+    return self.buffer.extend(batch)
 
   def ready(self) -> bool:
     """True once the buffer holds min_fill transitions (latching —

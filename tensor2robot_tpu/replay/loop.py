@@ -258,6 +258,15 @@ class ReplayLoopConfig:
   seed: int = 0
   min_fill_timeout_s: float = 300.0
   model_kwargs: Dict = field(default_factory=dict)
+  # Device-resident learner (ISSUE 4): replay state lives on device and
+  # training runs as ONE donated megastep executable scanning
+  # `megastep_inner` sample→label→train→reprioritize iterations per
+  # dispatch; the numpy ring + per-step host path above stays the
+  # fallback (device_resident=False). `ingest_chunk` is the fixed H2D
+  # staging quantum (one extend executable).
+  device_resident: bool = False
+  megastep_inner: int = 10
+  ingest_chunk: int = 64
 
 
 class ReplayTrainLoop:
@@ -282,7 +291,17 @@ class ReplayTrainLoop:
     self.trainer = Trainer(self.model, seed=config.seed)
     self.writer = MetricWriter(logdir)
     spec = transition_spec(config.image_size, config.action_size)
-    if config.num_buffer_shards > 1:
+    if config.device_resident:
+      # The device ring IS the sharded buffer on this path: storage
+      # shards over the capacity axis via the trainer's mesh (the
+      # num_buffer_shards host striping exists to relieve a host lock
+      # the device path doesn't have).
+      from tensor2robot_tpu.replay.device_buffer import DeviceReplayBuffer
+      self.buffer = DeviceReplayBuffer(
+          spec, config.capacity, config.batch_size, seed=config.seed,
+          prioritized=config.prioritized,
+          ingest_chunk=config.ingest_chunk, mesh=self.trainer.mesh)
+    elif config.num_buffer_shards > 1:
       self.buffer = ShardedReplayBuffer(
           spec, config.capacity, config.batch_size,
           num_shards=config.num_buffer_shards, seed=config.seed,
@@ -389,10 +408,69 @@ class ReplayTrainLoop:
         "eval_q_loss": float(np.mean(np.square(td))),
     }
 
+  # --- shared lifecycle (host + device paths) -------------------------------
+
+  def _start_collectors(self, policy) -> None:
+    c = self.config
+    self._collectors = [
+        CollectorWorker(policy, self.queue, c.image_size,
+                        num_envs=c.envs_per_collector,
+                        max_attempts=c.max_attempts,
+                        seed=c.seed + i, grasp_radius=c.grasp_radius,
+                        exploration_epsilon=c.exploration_epsilon,
+                        scripted_fraction=c.scripted_fraction)
+        for i in range(c.num_collectors)
+    ]
+    for collector in self._collectors:
+      collector.start()
+
+  def _shutdown_collectors(self) -> List[BaseException]:
+    """Shutdown order matters: signal EVERY collector before joining
+    any (one raising stop() must not leave siblings running and
+    contending for CPU); errors are returned, not raised, so the
+    caller can avoid masking an in-flight exception from the loop
+    body. Always closes the writer."""
+    for collector in self._collectors:
+      collector.request_stop()
+    errors: List[BaseException] = []
+    for collector in self._collectors:
+      collector._thread.join(30.0)
+      errors.extend(collector.errors)
+    self.writer.close()
+    return errors
+
+  def _assemble_result(self, steps: int, initial_eval, eval_history,
+                       ledger, param_refreshes: int, **extra) -> Dict:
+    """The result schema both loop paths share (one copy: a new field
+    lands on host AND device results or neither)."""
+    final_eval = eval_history[-1]
+    reduction = 1.0 - (final_eval["eval_td_error"]
+                       / max(initial_eval["eval_td_error"], 1e-9))
+    return {
+        "steps": steps,
+        "initial_eval": initial_eval,
+        "final_eval": {key: v for key, v in final_eval.items()
+                       if key != "step"},
+        "eval_history": eval_history,
+        "eval_td_reduction": round(reduction, 4),
+        "compile_counts": ledger,
+        "queue": self.queue.stats(),
+        "buffer": self.buffer.metrics(),
+        "episodes_collected": sum(c_.episodes for c_ in self._collectors),
+        "collector_success_rate": (
+            sum(c_.successes for c_ in self._collectors)
+            / max(1, sum(c_.episodes for c_ in self._collectors))),
+        "param_refreshes": param_refreshes,
+        "logdir": self.logdir,
+        **extra,
+    }
+
   # --- the loop ------------------------------------------------------------
 
   def run(self, num_steps: int) -> Dict:
     """Runs the closed loop for `num_steps` optimizer steps."""
+    if self.config.device_resident:
+      return self._run_device_resident(num_steps)
     c = self.config
     state = self.trainer.create_train_state(batch_size=c.batch_size)
     # Host snapshot feeds the collector predictor and the target net
@@ -411,17 +489,7 @@ class ReplayTrainLoop:
         iterations=c.cem_iterations, seed=c.seed + 13,
         polyak_tau=c.polyak_tau)
 
-    self._collectors = [
-        CollectorWorker(policy, self.queue, c.image_size,
-                        num_envs=c.envs_per_collector,
-                        max_attempts=c.max_attempts,
-                        seed=c.seed + i, grasp_radius=c.grasp_radius,
-                        exploration_epsilon=c.exploration_epsilon,
-                        scripted_fraction=c.scripted_fraction)
-        for i in range(c.num_collectors)
-    ]
-    for collector in self._collectors:
-      collector.start()
+    self._start_collectors(policy)
 
     try:
       self._wait_for_min_fill()
@@ -484,48 +552,130 @@ class ReplayTrainLoop:
           self.writer.write_scalars(
               step, {"replay/" + k: v for k, v in evals.items()})
     finally:
-      # Shutdown order matters: signal EVERY collector before joining
-      # any (one raising stop() must not leave siblings running and
-      # contending for CPU), always close the writer, and surface a
-      # collector error only when it wouldn't mask an in-flight
-      # exception from the loop body.
-      for collector in self._collectors:
-        collector.request_stop()
-      collector_errors = []
-      for collector in self._collectors:
-        collector._thread.join(30.0)
-        collector_errors.extend(collector.errors)
-      self.writer.close()
+      collector_errors = self._shutdown_collectors()
     if collector_errors:
       raise RuntimeError(
           f"{len(collector_errors)} collector error(s); first shown"
       ) from collector_errors[0]
 
-    final_eval = eval_history[-1]
-    reduction = 1.0 - (final_eval["eval_td_error"]
-                       / max(initial_eval["eval_td_error"], 1e-9))
     ledger = dict(self.compile_counts)
     ledger.update({f"bellman_{k}" if not k.startswith("bellman") else k: v
                    for k, v in updater.compile_counts.items()})
     ledger.update({f"cem_bucket_{k}": v
                    for k, v in sorted(policy.compile_counts.items())})
-    return {
-        "steps": num_steps,
-        "initial_eval": initial_eval,
-        "final_eval": {k: v for k, v in final_eval.items()
-                       if k != "step"},
-        "eval_history": eval_history,
-        "eval_td_reduction": round(reduction, 4),
-        "compile_counts": ledger,
-        "queue": self.queue.stats(),
-        "buffer": self.buffer.metrics(),
-        "episodes_collected": sum(c_.episodes for c_ in self._collectors),
-        "collector_success_rate": (
-            sum(c_.successes for c_ in self._collectors)
-            / max(1, sum(c_.episodes for c_ in self._collectors))),
-        "param_refreshes": updater.refresh_count,
-        "logdir": self.logdir,
-    }
+    return self._assemble_result(
+        num_steps, initial_eval, eval_history, ledger,
+        param_refreshes=updater.refresh_count)
+
+  def _run_device_resident(self, num_steps: int) -> Dict:
+    """The Anakin-shaped loop: host feeds transitions + reads metrics;
+    everything else runs inside ONE donated megastep executable.
+
+    Per outer iteration (= `megastep_inner` optimizer steps): the
+    feeder stages fresh transitions to the device ring (fixed-chunk
+    extend), one megastep dispatch scans K sample→CEM-label→train→
+    reprioritize iterations on device, and the host reads back scalar
+    metrics. Target refresh / collector param push / eval run between
+    dispatches on their step cadences (rounded to megastep
+    boundaries). `num_steps` rounds UP to a whole number of megasteps
+    so the compiled K never changes.
+    """
+    from tensor2robot_tpu.replay.device_buffer import MegastepLearner
+    c = self.config
+    k = c.megastep_inner
+    num_outer = max(1, -(-num_steps // k))  # ceil: whole megasteps only
+    state = self.trainer.create_train_state(batch_size=c.batch_size)
+    host_variables = self._host_variables(state)
+
+    predictor = _HotReloadPredictor(self.model, host_variables)
+    policy = self._make_policy(predictor)
+    # EVAL-ONLY updater: the megastep owns targets/TD on the hot path;
+    # the eval TD-vs-analytic-Q* metric reuses the host TD executable
+    # (one compile, targets executable never built on this path).
+    updater = BellmanUpdater(
+        self.model, host_variables, action_size=c.action_size,
+        gamma=c.gamma, num_samples=c.cem_num_samples,
+        num_elites=c.cem_num_elites, iterations=c.cem_iterations,
+        seed=c.seed + 13, polyak_tau=c.polyak_tau)
+    learner = MegastepLearner(
+        self.model, self.trainer, self.buffer,
+        action_size=c.action_size, gamma=c.gamma,
+        num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
+        iterations=c.cem_iterations, inner_steps=k, seed=c.seed + 13,
+        polyak_tau=c.polyak_tau)
+    # Cold-start target = initial online copy (BellmanUpdater parity);
+    # this counts as refresh 0, not a loop refresh.
+    learner.refresh(host_variables, step=0)
+
+    self._start_collectors(policy)
+
+    try:
+      self._wait_for_min_fill()
+      eval_batches, eval_q_stars = self._eval_transitions()
+      online = state.variables(use_ema=True)
+      initial_eval = self._eval(updater, online, eval_batches,
+                                eval_q_stars)
+      self.writer.write_scalars(
+          0, {"replay/" + key: v for key, v in initial_eval.items()})
+
+      eval_history = [dict(step=0, **initial_eval)]
+      final_metrics: Dict[str, float] = {}
+      prev_step = 0
+      for outer in range(1, num_outer + 1):
+        self.feeder.drain()
+        state, metrics = learner.step(state)
+        step = outer * k
+        # Cadences count OPTIMIZER steps: an event fires when its
+        # multiple falls inside this megastep's [prev_step+1, step].
+        crossed = lambda every: (step // every) > (prev_step // every)
+
+        if crossed(c.refresh_every):
+          host_variables = self._host_variables(state)
+          predictor.update(host_variables)
+          learner.refresh(host_variables, step)
+          updater.refresh(host_variables, step)
+        if crossed(c.log_every) or outer == num_outer:
+          final_metrics = {
+              "replay/train_loss": metrics["loss"],
+              "replay/train_td_error": metrics["td_error"],
+              "replay/train_q_next": metrics["q_next"],
+              "replay/sample_staleness": metrics["staleness"],
+              "replay/target_lag": float(learner.target_lag(step)),
+              "replay/episodes": float(
+                  sum(col.episodes for col in self._collectors)),
+              **self.buffer.metrics(),
+              **self.feeder.metrics(),
+          }
+          self.writer.write_scalars(step, final_metrics)
+        if crossed(c.eval_every) or outer == num_outer:
+          # Valid until the NEXT megastep donates the state away.
+          online = state.variables(use_ema=True)
+          evals = self._eval(updater, online, eval_batches,
+                             eval_q_stars)
+          eval_history.append(dict(step=step, **evals))
+          self.writer.write_scalars(
+              step, {"replay/" + key: v for key, v in evals.items()})
+        prev_step = step
+    finally:
+      collector_errors = self._shutdown_collectors()
+    if collector_errors:
+      raise RuntimeError(
+          f"{len(collector_errors)} collector error(s); first shown"
+      ) from collector_errors[0]
+
+    ledger = dict(self.compile_counts)
+    ledger.update(learner.compile_counts)
+    ledger.update(self.buffer.compile_counts)
+    ledger.update({f"bellman_{key}" if not key.startswith("bellman")
+                   else key: v
+                   for key, v in updater.compile_counts.items()})
+    ledger.update({f"cem_bucket_{key}": v
+                   for key, v in sorted(policy.compile_counts.items())})
+    return self._assemble_result(
+        num_outer * k, initial_eval, eval_history, ledger,
+        param_refreshes=learner.refresh_count - 1,  # minus cold-start
+        device_resident=True,
+        megastep_inner=k)
 
   def _wait_for_min_fill(self) -> None:
     """Gates the first optimizer step on buffer warm-up (min-fill)."""

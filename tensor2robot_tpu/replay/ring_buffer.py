@@ -51,7 +51,10 @@ class SampleInfo:
     append_count when the slot was written) — the replay-health metric
     the loop exports; rises when collection stalls behind training.
   probabilities: per-item sampling probability (importance-weight hook;
-    uniform batches carry 1/size).
+    uniform batches carry 1/size). ALWAYS float32: the device-resident
+    path (replay/device_buffer.py) computes in float32, and the host
+    path normalizes to the same dtype at this boundary so the two are
+    interchangeable downstream (ISSUE 4 dtype-drift satellite).
   """
   indices: np.ndarray
   staleness: np.ndarray
@@ -131,11 +134,30 @@ class ReplayBuffer:
     return slot
 
   def extend(self, transitions: Mapping[str, np.ndarray]) -> int:
-    """Appends a batch (leading axis on every leaf); returns count."""
+    """Appends a batch (leading axis on every leaf); returns count.
+
+    ONE vectorized slot write per key (the ingest extend path used to
+    re-copy every leaf per transition through append() — ISSUE 4
+    satellite). Exactly equivalent to n sequential appends, including
+    bursts larger than capacity: modular positions repeat and numpy
+    fancy-store keeps the LAST write per slot, which is precisely the
+    survivor a one-by-one wraparound leaves.
+    """
     arrays = self._validate(transitions, batched=True)
     n = next(iter(arrays.values())).shape[0]
-    for i in range(n):
-      self.append({key: array[i] for key, array in arrays.items()})
+    if n == 0:
+      return 0
+    with self._lock:
+      positions = (self._next + np.arange(n)) % self.capacity
+      for key, array in arrays.items():
+        self._storage[key][positions] = array
+      self._written_at[positions] = self._append_count + np.arange(n)
+      self._append_count += n
+      self._next = (self._next + n) % self.capacity
+      self._size = min(self._size + n, self.capacity)
+      if self._tree is not None:
+        # Max-priority insert for every fresh slot (append() parity).
+        self._tree.set(positions, self._max_priority)
     return n
 
   # --- reads ---------------------------------------------------------------
@@ -177,16 +199,26 @@ class ReplayBuffer:
           for key, array in self._storage.items()
       })
       staleness = self._append_count - self._written_at[indices]
+    # float32 at the boundary: the device path computes probabilities
+    # in float32; emitting float64 here made the two paths' SampleInfo
+    # dtypes drift (ISSUE 4 satellite). Tree math stays float64 inside.
     return batch, SampleInfo(indices=np.asarray(indices, np.int64),
                              staleness=np.asarray(staleness, np.int64),
-                             probabilities=probabilities)
+                             probabilities=np.asarray(probabilities,
+                                                      np.float32))
 
   def update_priorities(self, indices, td_errors) -> None:
-    """TD-error-proportional priority refresh for sampled slots."""
+    """TD-error-proportional priority refresh for sampled slots.
+
+    TD errors are normalized to float32 at this boundary (the device
+    path's native dtype): identical inputs now produce bit-identical
+    priorities on both paths instead of drifting in the f64 shaping.
+    """
     if self._tree is None:
       return
-    td = np.abs(np.asarray(td_errors, np.float64)).reshape(-1)
-    priorities = (td + self._min_priority) ** self._alpha
+    td = np.abs(np.asarray(td_errors, np.float32)).reshape(-1)
+    priorities = ((td + np.float32(self._min_priority))
+                  ** np.float32(self._alpha))
     with self._lock:
       self._tree.set(np.asarray(indices, np.int64).reshape(-1),
                      priorities)
@@ -302,11 +334,22 @@ class ShardedReplayBuffer:
   def extend(self, transitions: Mapping[str, np.ndarray]) -> int:
     # Validate the WHOLE batch first (mismatched leading dims fail here
     # with a named key), so a bad payload can never partially stripe
-    # into the shards before raising.
+    # into the shards before raising. Rows then stripe round-robin in
+    # ONE grouped vectorized write per shard — identical final state to
+    # n sequential appends (within a shard, row order is preserved, so
+    # slots and shard-local append indices match the one-by-one path).
     arrays = _validate_against_spec(self._spec, transitions, batched=True)
     n = next(iter(arrays.values())).shape[0]
-    for i in range(n):
-      self.append({key: array[i] for key, array in arrays.items()})
+    if n == 0:
+      return 0
+    with self._lock:
+      start = self._stripe
+      self._stripe = (self._stripe + n) % self.num_shards
+    shard_of = (start + np.arange(n)) % self.num_shards
+    for i, shard in enumerate(self._shards):
+      mask = shard_of == i
+      if mask.any():
+        shard.extend({key: array[mask] for key, array in arrays.items()})
     return n
 
   def sample(self) -> Tuple[ts.TensorSpecStruct, SampleInfo]:
@@ -334,7 +377,7 @@ class ShardedReplayBuffer:
 
   def update_priorities(self, indices, td_errors) -> None:
     indices = np.asarray(indices, np.int64).reshape(-1)
-    td = np.asarray(td_errors, np.float64).reshape(-1)
+    td = np.asarray(td_errors, np.float32).reshape(-1)
     shard_of = indices // self._shard_capacity
     local = indices % self._shard_capacity
     for i, shard in enumerate(self._shards):

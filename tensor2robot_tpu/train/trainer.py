@@ -323,6 +323,17 @@ class Trainer:
 
   # --- public API ----------------------------------------------------------
 
+  def train_step_fn(self):
+    """The UNCOMPILED (state, features, labels) -> (state', metrics) body.
+
+    For fused consumers that inline the optimizer step into a larger
+    compiled program (replay/device_buffer.py's megastep scans it K
+    times inside one donated executable). Callers own compilation;
+    the body carries the trainer's RNG fold-from-step discipline, so a
+    scan over it replays the identical randomness stream as K separate
+    `train_step` calls."""
+    return self._make_train_step_fn()
+
   def train_step(self, state: TrainState, features, labels=None
                  ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     """One compiled optimizer step. Donates `state`."""

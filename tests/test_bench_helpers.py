@@ -290,6 +290,26 @@ class TestServingDetailBlock:
     assert '"serving": serving' in src
 
 
+class TestLearnerDetailBlock:
+  """ISSUE 4: the bench detail carries the learner-throughput block so
+  a driver-only chip window re-measures the fused-megastep-vs-host
+  ratio on the real chip. Functional coverage (spread shapes, speedup,
+  ledger) lives in tests/test_device_replay.py's CLI smoke — here we
+  pin the fail-safe wiring only, like every evidence section."""
+
+  def test_learner_block_failure_is_contained(self):
+    src = _load_bench_source()
+    assert "learner = _bench_learner_compact()" in src
+    idx = src.index("learner = _bench_learner_compact()")
+    window = src[idx - 200:idx + 200]
+    assert "try:" in window and "except Exception" in window
+    assert '"learner": learner' in src
+
+  def test_compact_line_carries_learner_speedup(self):
+    src = _load_bench_source()
+    assert '"learner_megastep_speedup"' in src
+
+
 def _expand_braces(name):
   """`a_{x,y}.b` -> [`a_x.b`, `a_y.b`] (single brace group)."""
   m = re.match(r"^(.*)\{([^}]+)\}(.*)$", name)

@@ -200,6 +200,57 @@ class TestReplayBuffer:
                 "replay/append_count", "replay/priority_entropy"):
       assert key in metrics
 
+  def test_probabilities_and_priorities_are_float32_at_boundary(self):
+    """ISSUE 4 dtype satellite: the host path used to emit float64
+    probabilities and shape priorities in float64 while the device
+    path is float32-native; both now normalize at the boundary."""
+    for kwargs in ({}, {"prioritized": True}):
+      buf = self._buffer(capacity=8, batch=4, **kwargs)
+      for i in range(8):
+        buf.append(_transition(i))
+      _, info = buf.sample()
+      assert info.probabilities.dtype == np.float32
+    sharded = ShardedReplayBuffer(
+        transition_spec(IMG, 4), capacity=8, sample_batch_size=4,
+        num_shards=2, seed=0, prioritized=True)
+    for i in range(8):
+      sharded.append(_transition(i))
+    _, info = sharded.sample()
+    assert info.probabilities.dtype == np.float32
+    # float64 TD input is accepted and lands as the float32-shaped
+    # priority (identical to feeding float32 — no drift between paths).
+    buf = self._buffer(capacity=4, batch=4, prioritized=True,
+                       priority_exponent=1.0)
+    for i in range(4):
+      buf.append(_transition(i))
+    buf.update_priorities([0], np.asarray([0.5], np.float64))
+    buf.update_priorities([1], np.asarray([0.5], np.float32))
+    assert (buf._tree.get([0])[0] == buf._tree.get([1])[0])
+
+  def test_extend_matches_sequential_appends(self):
+    """Vectorized extend (single slot write per key) must leave the
+    EXACT state n sequential appends leave — including a burst larger
+    than capacity, where modular fancy-store keeps the last writer."""
+    def batch(n):
+      items = [_transition(i, reward=float(i)) for i in range(n)]
+      return {key: np.stack([item[key] for item in items])
+              for key in items[0]}
+
+    for n in (3, 6, 11):  # under / over capacity 4, with wraparound
+      by_append = self._buffer(capacity=4, batch=4, prioritized=True)
+      for i in range(n):
+        by_append.append(_transition(i, reward=float(i)))
+      by_extend = self._buffer(capacity=4, batch=4, prioritized=True)
+      by_extend.extend(batch(n))
+      assert by_extend._next == by_append._next
+      assert by_extend._size == by_append._size
+      assert by_extend._append_count == by_append._append_count
+      np.testing.assert_array_equal(by_extend._written_at,
+                                    by_append._written_at)
+      for key in by_append._storage:
+        np.testing.assert_array_equal(by_extend._storage[key],
+                                      by_append._storage[key])
+
 
 class TestShardedReplayBuffer:
 
@@ -270,6 +321,54 @@ class TestIngest:
     stats = queue.stats()
     assert stats["enqueued"] == (stats["dropped"] + stats["dequeued"]
                                  + stats["pending"])
+
+  def test_drain_batch_single_concatenate(self):
+    queue = TransitionQueue(capacity=8)
+    assert queue.drain_batch() is None  # empty: allocation-free path
+    for i in range(5):
+      queue.put(_transition(i))
+    batch = queue.drain_batch(max_items=3)
+    assert batch["action"].shape == (3, 4)
+    np.testing.assert_array_equal(batch["action"][:, 0], [0.0, 1.0, 2.0])
+    assert queue.stats()["dequeued"] == 3 and len(queue) == 2
+
+  def test_shed_accounting_under_concurrent_put_and_drain(self):
+    """ISSUE 4 satellite: the conservation law enqueued == dropped +
+    dequeued + pending must hold exactly while producers race the
+    batched drain path (the counters and the deque share one lock;
+    a miscount here silently corrupts the drop_rate health metric)."""
+    import threading
+    queue = TransitionQueue(capacity=16)
+    per_thread, n_threads = 200, 4
+    drained_rows = [0]
+    stop = threading.Event()
+
+    def producer(tid):
+      for i in range(per_thread):
+        queue.put(_transition(tid * per_thread + i))
+
+    def consumer():
+      while not stop.is_set():
+        batch = queue.drain_batch(max_items=8)
+        if batch is not None:
+          drained_rows[0] += batch["reward"].shape[0]
+
+    threads = [threading.Thread(target=producer, args=(tid,))
+               for tid in range(n_threads)]
+    drainer = threading.Thread(target=consumer)
+    drainer.start()
+    for thread in threads:
+      thread.start()
+    for thread in threads:
+      thread.join()
+    stop.set()
+    drainer.join()
+    stats = queue.stats()
+    assert stats["enqueued"] == per_thread * n_threads
+    assert stats["enqueued"] == (stats["dropped"] + stats["dequeued"]
+                                 + stats["pending"])
+    # Every dequeued transition actually reached a stacked batch.
+    assert drained_rows[0] == stats["dequeued"]
 
   def test_min_fill_gating(self):
     queue = TransitionQueue(capacity=16)
