@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 7
+ROUND = 8
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -930,6 +930,23 @@ def _bench_serving_compact(trials=3, control_steps=10, image_size=None):
   return out
 
 
+def _bench_actor_compact():
+  """Actor-throughput block for the bench detail (ISSUE 5).
+
+  Same driver-refreshable rationale as the serving and learner blocks:
+  the committed replay artifact (REPLAY_SMOKE_r0N.json) carries the
+  chipless actor comparison, but a driver-only chip window should still
+  re-measure the vector-vs-threaded acting ratio and the
+  acting/learning overlap fraction on the real host+chip pair. Runs
+  replay/actor_bench's collector-only comparison (one shared TinyQ
+  predictor, same CEM hyperparameters, same total env count on both
+  paths; the threaded scalar collectors ARE the measured fallback);
+  every citable field carries the {median,min,max,trials} spread.
+  """
+  from tensor2robot_tpu.replay.actor_bench import measure_actor_throughput
+  return measure_actor_throughput()
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1066,6 +1083,11 @@ def main() -> None:
   except Exception as e:
     learner = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    actor = _bench_actor_compact()
+  except Exception as e:
+    actor = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1121,6 +1143,7 @@ def main() -> None:
       "input_pipeline": input_pipeline,
       "serving": serving,
       "learner": learner,
+      "actor": actor,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1139,6 +1162,8 @@ def main() -> None:
           "record_fed_uint8", {}).get(
               "cold_steps_per_sec", {}).get("median"),
       "learner_megastep_speedup": learner.get(
+          "speedup", {}).get("median"),
+      "actor_fleet_speedup": actor.get(
           "speedup", {}).get("median"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,

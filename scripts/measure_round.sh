@@ -21,9 +21,15 @@
 #
 # Stages (artifact -> producer):
 #   REPLAY_SMOKE_r0N.json        bin/run_qtopt_replay --smoke
-#                                --device-resident (CHIPLESS backstop,
-#                                runs before any chip appears; normally
-#                                builder-committed and skipped — ISSUE 4)
+#                                --device-resident --vector-actors
+#                                (CHIPLESS backstop, runs before any
+#                                chip appears; normally builder-
+#                                committed and skipped — ISSUE 4/5.
+#                                This IS the actor-bench stage too: the
+#                                artifact's actor_throughput block
+#                                carries the vector-vs-threaded acting
+#                                ratio and the acting/learning overlap
+#                                fraction)
 #   BENCH_DETAIL_r0N.json        bench.py (orchestrator; also emits the
 #                                compact line, saved to BENCH_builder_r0N.json)
 #   SERVING_r0N.json             bin/bench_serving single-robot + --fleet lines
@@ -107,7 +113,7 @@ else
   done
   run_stage "REPLAY_SMOKE_${RTAG}.json" 1800 sh -c '
     python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke \
-      --device-resident --out "$STAGE_TMP"'
+      --device-resident --vector-actors --out "$STAGE_TMP"'
 fi
 while [ "$(date +%s)" -lt "$deadline" ]; do
   # Never perturb a live test run: the probe's jax import is real CPU

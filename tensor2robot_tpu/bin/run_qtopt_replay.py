@@ -9,6 +9,8 @@ train step — with the compiled-program ledger in the output.
 
     python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke
     python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke --device-resident
+    python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke \
+        --device-resident --vector-actors
 
 `--device-resident` (ISSUE 4) keeps replay state on device and fuses
 K = megastep_inner sample→CEM-label→train→reprioritize iterations into
@@ -19,15 +21,27 @@ is the PR 2 host-path loop, kept as the fallback. With
 fraction, device-vs-host speedup at the same batch shape — the
 replay/learner_bench.py comparison; skip with `--no-learner-bench`).
 
+`--vector-actors` (ISSUE 5) replaces the threaded scalar collectors
+with the vectorized actor fleet (replay/actor.py): every env steps in
+lockstep through ONE fused CEM bucket executable, feeding the queue in
+fixed fleet-size chunks, overlapped with the learner. Collection
+semantics (retry budget, exploration mix, scene-seed stream) are
+unchanged; the threaded path stays the default and the measured
+fallback. The output additionally carries an `actor_throughput` block
+(env steps/s, transitions/s, vector-vs-threaded speedup at the same
+policy and env count, and the acting/learning overlap fraction — the
+replay/actor_bench.py comparison; skip with `--no-actor-bench`).
+
 Prints ONE JSON line (the repo's bench/driver contract): initial/final
 eval Bellman residual, the reduction fraction, replay health counters,
 and `compile_counts` (every value must be 1 — fixed-shape sampling
 never recompiles; on the device path that includes exactly one
-megastep executable). `--smoke` is the chipless CI scale (tier-1
+megastep executable, and with vector actors exactly one acting
+executable per bucket). `--smoke` is the chipless CI scale (tier-1
 asserts a >= 30% residual reduction on it); the default scale is the
 same loop with a bigger buffer/budget for on-chip runs. `--out`
 additionally writes the same JSON to a file (the committed smoke
-artifact, REPLAY_SMOKE_r07.json for this round).
+artifact, REPLAY_SMOKE_r08.json for this round).
 """
 
 from __future__ import annotations
@@ -38,10 +52,12 @@ import os
 import tempfile
 
 
-def build_config(smoke: bool, seed: int, device_resident: bool = False):
+def build_config(smoke: bool, seed: int, device_resident: bool = False,
+                 vector_actors: bool = False):
   from tensor2robot_tpu.replay.loop import ReplayLoopConfig
   if smoke:
-    return ReplayLoopConfig(seed=seed, device_resident=device_resident)
+    return ReplayLoopConfig(seed=seed, device_resident=device_resident,
+                            vector_actors=vector_actors)
   return ReplayLoopConfig(
       image_size=64, batch_size=32, capacity=50_000, min_fill=2_000,
       num_buffer_shards=4, num_collectors=4, envs_per_collector=8,
@@ -49,13 +65,14 @@ def build_config(smoke: bool, seed: int, device_resident: bool = False):
       cem_iterations=3, refresh_every=200, eval_every=500,
       eval_batches=8, log_every=50, learning_rate=1e-4, seed=seed,
       device_resident=device_resident, megastep_inner=50,
-      ingest_chunk=256)
+      ingest_chunk=256, vector_actors=vector_actors)
 
 
 def run(steps: int, smoke: bool, logdir: str, seed: int,
-        device_resident: bool = False, learner_bench: bool = True) -> dict:
+        device_resident: bool = False, learner_bench: bool = True,
+        vector_actors: bool = False, actor_bench: bool = True) -> dict:
   from tensor2robot_tpu.replay.loop import ReplayTrainLoop
-  config = build_config(smoke, seed, device_resident)
+  config = build_config(smoke, seed, device_resident, vector_actors)
   model = None  # default: the flagship QTOptGraspingModel
   if smoke:
     # CI-scale critic (replay/smoke.py): the flagship's conv tower
@@ -83,6 +100,23 @@ def run(steps: int, smoke: bool, logdir: str, seed: int,
         cem_num_elites=config.cem_num_elites,
         cem_iterations=config.cem_iterations,
         gamma=config.gamma, seed=seed)
+  if vector_actors and actor_bench:
+    # The ISSUE 5 acceptance block: vector-vs-threaded actor throughput
+    # at the same policy and env count, plus the acting/learning
+    # overlap fraction (collector-free ratio; replay/actor_bench).
+    from tensor2robot_tpu.replay.actor_bench import (
+        measure_actor_throughput)
+    results["actor_throughput"] = measure_actor_throughput(
+        image_size=config.image_size if smoke else 16,
+        action_size=config.action_size,
+        max_attempts=config.max_attempts,
+        grasp_radius=config.grasp_radius,
+        exploration_epsilon=config.exploration_epsilon,
+        scripted_fraction=config.scripted_fraction,
+        cem_num_samples=config.cem_num_samples,
+        cem_num_elites=config.cem_num_elites,
+        cem_iterations=config.cem_iterations,
+        batch_size=config.batch_size, gamma=config.gamma, seed=seed)
   results["mode"] = "smoke" if smoke else "full"
   results["metric"] = ("QT-Opt off-policy replay loop: eval Bellman "
                        "residual reduction")
@@ -101,6 +135,14 @@ def main(argv=None) -> None:
   parser.add_argument("--no-learner-bench", action="store_true",
                       help="skip the learner_throughput comparison "
                            "block on --device-resident runs")
+  parser.add_argument("--vector-actors", action="store_true",
+                      help="vectorized actor fleet: batched env "
+                           "stepping through one fused CEM bucket "
+                           "executable (threaded scalar collectors "
+                           "are the default fallback)")
+  parser.add_argument("--no-actor-bench", action="store_true",
+                      help="skip the actor_throughput comparison "
+                           "block on --vector-actors runs")
   parser.add_argument("--logdir", default=None,
                       help="metric_writer logdir (default: a tempdir)")
   parser.add_argument("--seed", type=int, default=0)
@@ -115,7 +157,9 @@ def main(argv=None) -> None:
   logdir = args.logdir or tempfile.mkdtemp(prefix="qtopt_replay_")
   results = run(steps, args.smoke, logdir, args.seed,
                 device_resident=args.device_resident,
-                learner_bench=not args.no_learner_bench)
+                learner_bench=not args.no_learner_bench,
+                vector_actors=args.vector_actors,
+                actor_bench=not args.no_actor_bench)
   line = json.dumps(results)
   if args.out:
     with open(args.out, "w") as f:

@@ -22,6 +22,12 @@ set, host-RAM replay:
   jittable extend/sample/reprioritize, plus the fused Anakin-style
   megastep — K sample -> CEM-label -> train -> reprioritize iterations
   in ONE donated AOT executable (``ReplayLoopConfig.device_resident``);
+- ``VectorActor`` / ``ActorFleet`` (actor.py, ISSUE 5): the batched
+  actor side — every env stepped in lockstep through ONE fused CEM
+  bucket executable (`synthetic_grasping.VectorGraspEnv` underneath),
+  feeding the queue in fixed fleet-size chunks, double-buffered
+  against the megastep learner (``ReplayLoopConfig.vector_actors``;
+  the threaded CollectorWorker path is the fallback);
 - ``ReplayTrainLoop`` (loop.py): async collect -> replay -> train
   driver wiring serving's CEMFleetPolicy collectors, the buffer, the
   updater, and train/trainer.py together, with replay-health metrics
@@ -30,6 +36,7 @@ set, host-RAM replay:
 Entry point: ``python -m tensor2robot_tpu.bin.run_qtopt_replay``.
 """
 
+from tensor2robot_tpu.replay.actor import ActorFleet, VectorActor
 from tensor2robot_tpu.replay.bellman import BellmanUpdater
 from tensor2robot_tpu.replay.device_buffer import (DeviceReplayBuffer,
                                                    DeviceReplayState,
@@ -43,6 +50,7 @@ from tensor2robot_tpu.replay.ring_buffer import (ReplayBuffer, SampleInfo,
 from tensor2robot_tpu.replay.sum_tree import SumTree
 
 __all__ = [
+    "ActorFleet",
     "BellmanUpdater",
     "CollectorWorker",
     "DeviceReplayBuffer",
@@ -56,6 +64,7 @@ __all__ = [
     "ShardedReplayBuffer",
     "SumTree",
     "TransitionQueue",
+    "VectorActor",
     "episode_to_transitions",
     "transition_spec",
 ]

@@ -1,0 +1,252 @@
+"""Vectorized actor fleet: batched env stepping through one fused
+CEM executable per actor-batch bucket (ISSUE 5 tentpole).
+
+PR 3 fused the learner into a single device-resident megastep, which
+moved the QT-Opt loop's bottleneck to the actor side: the PR 2
+collectors are Python threads, each stepping a small `GraspRetryEnv`
+fleet through its own `CEMFleetPolicy` bucket call — per-step
+host↔device round-trips and GIL contention scale with the THREAD
+count, not the env count. Podracer (PAPERS.md, arXiv:2104.06272) makes
+the counter-argument this module implements: Sebulba/Anakin throughput
+comes from *batched acting* — many environments stepped in lockstep
+through one compiled control step — co-scheduled with learning, and
+the pjit/TPUv4 scaling study (arXiv:2204.06514) adds the shape
+discipline: both phases stay a small fixed set of XLA executables.
+
+The pieces:
+
+- ``VectorActor``: one thread driving a ``VectorGraspEnv`` (all N
+  scenes as stacked arrays, one numpy call per control step) through
+  ONE `CEMFleetPolicy` bucket executable per step — the policy's
+  ladder is pinned to the actor batch, so acting compiles exactly one
+  executable for the life of the fleet, and param refresh rides the
+  hot-reload contract (variables are executable ARGUMENTS — the same
+  never-recompile discipline the megastep holds). Each step feeds the
+  whole fleet batch to ``TransitionQueue.put_batch`` as one fixed-size
+  chunk, which the device ring's jittable fixed-chunk extend consumes
+  without ever seeing a new shape.
+- ``ActorFleet``: the driver — owns the actors, starts/stops their
+  threads, and aggregates episode/step/busy-time accounting. Acting
+  runs on its own thread(s) double-buffered against the learner: while
+  the train thread blocks inside a megastep dispatch (the GIL is
+  released during XLA execution), the fleet is producing the next
+  transitions, so collection and training OVERLAP instead of
+  interleaving. ``busy_seconds()`` is the instrument: the actor bench
+  reads it across a learner window to report the acting/learning
+  overlap fraction as a measurement, not a diagram.
+
+Collection semantics are UNCHANGED from the scalar collectors (PARITY
+note): same retry budget (`max_attempts`), same epsilon-uniform +
+scripted near-object exploration mix drawn in the same per-step order,
+same scene-seed formula, same static-scene transition layout
+(next_image == scene image; truncation bootstraps with done=0). Scope
+of the parity claim: one VectorActor is bit-identical to ITS env count
+worth of scalar envs sharing one seed stream (the property
+tests/test_actor.py pins); a threaded MULTI-collector loop runs one
+independent stream per worker, so against it the parity is
+formula-level, not stream-level — that path's scene assignment is
+thread-timing-dependent anyway. The scalar `CollectorWorker` path
+stays in replay/loop.py as the measured fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.replay.ingest import TransitionQueue
+
+
+class VectorActor:
+  """One thread stepping N envs in lockstep through a batched policy.
+
+  The vectorized counterpart of `loop.CollectorWorker`: one
+  `policy(images)` call covers the WHOLE actor batch (one bucket
+  executable), one `VectorGraspEnv.step` computes every outcome, and
+  one `TransitionQueue.put_batch` hands the fleet's transitions over
+  as a single fixed-size chunk.
+  """
+
+  def __init__(self, policy, queue: TransitionQueue, image_size: int,
+               num_envs: int = 32, max_attempts: int = 4,
+               seed: int = 0, grasp_radius: float = 0.35,
+               exploration_epsilon: float = 0.2,
+               scripted_fraction: float = 0.25):
+    from tensor2robot_tpu.research.qtopt.synthetic_grasping import (
+        VectorGraspEnv)
+    self._policy = policy
+    self._queue = queue
+    # Exploration mix, QT-Opt parity — the same recipe, draw order, and
+    # rng stream seeding as CollectorWorker (see its inline rationale:
+    # scripted successes are what keep a cold critic off the base
+    # rate); only the fleet width differs.
+    self._epsilon = exploration_epsilon
+    self._scripted = scripted_fraction
+    self._explore_rng = np.random.default_rng(seed + 555)
+    self._env = VectorGraspEnv(
+        num_envs, image_size=image_size, max_attempts=max_attempts,
+        radius=grasp_radius)
+    self._seed = seed
+    self._next_scene = 0
+    self.env_steps = 0
+    self.busy_seconds = 0.0
+    self.errors: List[BaseException] = []
+    self._stop = threading.Event()
+    self._thread = threading.Thread(target=self._run, daemon=True)
+
+  @property
+  def num_envs(self) -> int:
+    return self._env.num_envs
+
+  @property
+  def episodes(self) -> int:
+    return self._env.episodes
+
+  @property
+  def successes(self) -> int:
+    return self._env.successes
+
+  def start(self) -> None:
+    self._env.reset([self._scene_seed()
+                     for _ in range(self._env.num_envs)])
+    self._thread.start()
+
+  def request_stop(self) -> None:
+    """Signals the thread; returns immediately (never raises)."""
+    self._stop.set()
+
+  def stop(self, timeout: float = 30.0) -> None:
+    """Signal + join + surface any recorded error (CollectorWorker
+    contract: a multi-actor owner should request_stop() on every actor
+    first, then join)."""
+    self.request_stop()
+    self._thread.join(timeout)
+    if self.errors:
+      raise RuntimeError("actor died") from self.errors[0]
+
+  def _scene_seed(self) -> int:
+    # CollectorWorker._scene_seed, verbatim: one monotonic counter over
+    # the whole fleet, so scene assignment matches the scalar path's.
+    seed = self._seed * 1_000_003 + self._next_scene
+    self._next_scene += 1
+    return seed
+
+  def _run(self) -> None:
+    try:
+      while not self._stop.is_set():
+        start = time.perf_counter()
+        self.step_once()
+        self.busy_seconds += time.perf_counter() - start
+    except BaseException as e:  # noqa: BLE001 — surfaced via stop()
+      self.errors.append(e)
+
+  def step_once(self) -> None:
+    """One batched control step: act → step → enqueue, all fleet-wide.
+
+    The scene snapshot is taken BEFORE the env steps: auto-reset
+    overwrites terminated envs' rows in place, and a terminal
+    transition's observation/next_image must be the OLD scene (static
+    scene, no bootstrap leak across the reset — the scalar path's
+    `[scene] * (t + 1)` episode stack holds the same invariant).
+    """
+    env = self._env
+    n = env.num_envs
+    scenes = env.images.copy()
+    targets = env.targets.copy()
+    actions = np.asarray(self._policy(scenes))
+    draw = self._explore_rng.random(n)
+    uniform = self._explore_rng.uniform(
+        -1.0, 1.0, actions.shape).astype(np.float32)
+    scripted = uniform.copy()
+    noise = self._explore_rng.normal(0.0, 0.12, (n, 2)).astype(np.float32)
+    scripted[:, :2] = np.clip(targets + noise, -1.0, 1.0)
+    actions = np.where((draw < self._epsilon)[:, None], uniform, actions)
+    actions = np.where(
+        (draw >= 1.0 - self._scripted)[:, None], scripted, actions)
+    rewards, dones, _ = env.step(actions, seed_fn=self._scene_seed)
+    self.env_steps += n
+    # ONE fixed-size chunk per step (n never changes): image and
+    # next_image alias the same snapshot on purpose — the scene is
+    # static, and the buffer copies at its door anyway.
+    self._queue.put_batch({
+        "image": scenes,
+        "action": actions.astype(np.float32, copy=False),
+        "reward": rewards,
+        "done": dones,
+        "next_image": scenes,
+    })
+
+
+class ActorFleet:
+  """Driver for the vectorized actors: lifecycle + fleet accounting.
+
+  Owns `num_actors` `VectorActor`s (total_envs split evenly across
+  them; one actor — one bucket executable — is the default and the
+  measured configuration). The surface mirrors a CollectorWorker list
+  so `ReplayTrainLoop`'s shared shutdown path drives either kind:
+  `actors` is that list.
+  """
+
+  def __init__(self, policy, queue: TransitionQueue, image_size: int,
+               total_envs: int, max_attempts: int = 4, seed: int = 0,
+               grasp_radius: float = 0.35,
+               exploration_epsilon: float = 0.2,
+               scripted_fraction: float = 0.25,
+               num_actors: int = 1):
+    if num_actors < 1 or total_envs % num_actors:
+      raise ValueError(
+          f"total_envs {total_envs} must split evenly over "
+          f"num_actors {num_actors}")
+    self.actors = [
+        VectorActor(policy, queue, image_size,
+                    num_envs=total_envs // num_actors,
+                    max_attempts=max_attempts, seed=seed + i,
+                    grasp_radius=grasp_radius,
+                    exploration_epsilon=exploration_epsilon,
+                    scripted_fraction=scripted_fraction)
+        for i in range(num_actors)
+    ]
+
+  def start(self) -> None:
+    for actor in self.actors:
+      actor.start()
+
+  def request_stop(self) -> None:
+    for actor in self.actors:
+      actor.request_stop()
+
+  def stop(self, timeout: float = 30.0) -> None:
+    """Signal every actor before joining any (one dead actor must not
+    leave siblings running); surfaces the first recorded error."""
+    self.request_stop()
+    errors: List[BaseException] = []
+    for actor in self.actors:
+      actor._thread.join(timeout)
+      errors.extend(actor.errors)
+    if errors:
+      raise RuntimeError(
+          f"{len(errors)} actor error(s); first shown") from errors[0]
+
+  # --- fleet accounting (the bench's instruments) -------------------------
+
+  @property
+  def env_steps(self) -> int:
+    return sum(actor.env_steps for actor in self.actors)
+
+  @property
+  def episodes(self) -> int:
+    return sum(actor.episodes for actor in self.actors)
+
+  @property
+  def successes(self) -> int:
+    return sum(actor.successes for actor in self.actors)
+
+  def busy_seconds(self) -> float:
+    """Total wall seconds the actor threads spent inside acting steps
+    (policy call + env step + enqueue). Read against a concurrent
+    learner window, busy/wall is the acting/learning overlap fraction:
+    ~1.0 means collection never paused while the learner trained."""
+    return sum(actor.busy_seconds for actor in self.actors)

@@ -70,8 +70,22 @@ def episode_to_transitions(
   } for i in range(t)]
 
 
+def _chunk_rows(chunk: Mapping[str, np.ndarray]) -> int:
+  return next(iter(chunk.values())).shape[0]
+
+
 class TransitionQueue:
   """Bounded thread-safe transition queue, drop-oldest on overflow.
+
+  Storage is CHUNKED (ISSUE 5): items in the deque are stacked batches
+  of 1..n transitions, so a vectorized actor's per-step fleet batch
+  enters as ONE append (no per-row Python churn) and ``drain_batch``
+  can hand a single producer chunk straight through without re-stacking.
+  Capacity, the drop-oldest policy, and every counter are denominated
+  in TRANSITIONS (rows), never chunks: a vector put that overflows
+  sheds exactly as many rows as a sequence of scalar puts would, and
+  counts each one — drop-oldest slices partial chunks rather than
+  rounding the shed to chunk boundaries.
 
   Counters (all monotonic, read via stats()):
     enqueued: transitions accepted from collectors.
@@ -84,6 +98,7 @@ class TransitionQueue:
       raise ValueError(f"capacity must be >= 1, got {capacity}")
     self.capacity = capacity
     self._items: Deque[Dict[str, np.ndarray]] = deque()
+    self._rows = 0
     self._lock = threading.Lock()
     self.enqueued = 0
     self.dropped = 0
@@ -92,33 +107,95 @@ class TransitionQueue:
   def put_episode(self, episode: Mapping[str, np.ndarray]) -> int:
     """Flattens an episode and enqueues its transitions; returns count."""
     transitions = episode_to_transitions(episode)
-    with self._lock:
-      for transition in transitions:
-        if len(self._items) >= self.capacity:
-          self._items.popleft()
-          self.dropped += 1
-        self._items.append(transition)
-        self.enqueued += 1
+    if not transitions:
+      return 0
+    self.put_batch({key: np.stack([t[key] for t in transitions])
+                    for key in TRANSITION_KEYS})
     return len(transitions)
 
   def put(self, transition: Dict[str, np.ndarray]) -> None:
     """Enqueues one transition (drop-oldest when full)."""
+    self.put_batch({key: np.asarray(value)[None]
+                    for key, value in transition.items()})
+
+  def put_batch(self, batch: Mapping[str, np.ndarray]) -> int:
+    """Enqueues n stacked transitions as ONE chunk; returns n.
+
+    The vectorized actor's fixed-chunk producer call: one fleet step's
+    (n, ...) arrays enter in a single lock hold. Overflow sheds the
+    OLDEST rows first — slicing the head chunk when the overflow lands
+    mid-chunk — and `dropped` counts every shed ROW (a dropped batch of
+    k transitions is k drops, not 1: the drop_rate health metric pages
+    on transitions, so batch-granular counting would understate
+    shedding by the chunk size). A put larger than capacity keeps only
+    the batch's newest `capacity` rows (its own head is the oldest
+    experience in sight).
+
+    Ownership transfers with the call: the queue stores the caller's
+    arrays WITHOUT copying (that zero-copy hand-through to the buffer's
+    extend is the point of chunked storage), so producers must build
+    fresh arrays per put — mutating a staging buffer after put_batch
+    would silently rewrite queued transitions.
+    """
+    chunk = {key: np.asarray(value) for key, value in batch.items()}
+    sizes = {value.shape[0] for value in chunk.values()}
+    if len(sizes) != 1:
+      raise ValueError(f"inconsistent chunk leading dims: {sizes}")
+    n = sizes.pop()
+    if n == 0:
+      return 0
     with self._lock:
-      if len(self._items) >= self.capacity:
+      self.enqueued += n
+      if n >= self.capacity:
+        shed = self._rows + (n - self.capacity)
+        self._items.clear()
+        self._items.append(
+            {key: value[n - self.capacity:]
+             for key, value in chunk.items()})
+        self._rows = self.capacity
+        self.dropped += shed
+        return n
+      overflow = self._rows + n - self.capacity
+      if overflow > 0:
+        _, shed = self._pop_rows_locked(overflow)
+        self.dropped += shed
+      self._items.append(chunk)
+      self._rows += n
+    return n
+
+  def _pop_rows_locked(self, limit: int):
+    """Pops up to `limit` rows of chunks off the head (sliced when the
+    limit lands mid-chunk); caller holds the lock and advances the
+    matching counter — `dequeued` for drains, `dropped` for shedding —
+    by the returned row count. Returns (chunks, rows_popped)."""
+    taken: List[Dict[str, np.ndarray]] = []
+    popped = 0
+    while popped < limit and self._items:
+      head = self._items[0]
+      rows = _chunk_rows(head)
+      need = limit - popped
+      if rows <= need:
         self._items.popleft()
-        self.dropped += 1
-      self._items.append(transition)
-      self.enqueued += 1
+        taken.append(head)
+      else:
+        taken.append({key: value[:need] for key, value in head.items()})
+        self._items[0] = {key: value[need:]
+                          for key, value in head.items()}
+        rows = need
+      self._rows -= rows
+      popped += rows
+    return taken, popped
 
   def drain(self, max_items: Optional[int] = None
             ) -> List[Dict[str, np.ndarray]]:
-    """Pops up to max_items (default: all) in FIFO order."""
+    """Pops up to max_items (default: all) as per-transition dicts,
+    FIFO order (chunks are unstacked into row views outside the lock)."""
     with self._lock:
-      n = len(self._items) if max_items is None else min(
-          max_items, len(self._items))
-      out = [self._items.popleft() for _ in range(n)]
-      self.dequeued += n
-    return out
+      chunks, popped = self._pop_rows_locked(
+          self._rows if max_items is None else max_items)
+      self.dequeued += popped
+    return [{key: value[i] for key, value in chunk.items()}
+            for chunk in chunks for i in range(_chunk_rows(chunk))]
 
   def drain_batch(self, max_items: Optional[int] = None
                   ) -> Optional[Dict[str, np.ndarray]]:
@@ -127,27 +204,30 @@ class TransitionQueue:
     The buffer-extend path used to copy every leaf twice: drain() built
     per-transition dicts, then the feeder's per-item appends copied each
     leaf again into storage (ISSUE 4 satellite). This emits a single
-    stacked array per key — one concatenate — which ReplayBuffer.extend
-    writes with one vectorized slot store. Only the pop runs under the
-    lock; the stacking works on the popped items outside it, so
-    concurrent put() is never blocked behind the copy.
+    stacked array per key — one concatenate, and ZERO copies when the
+    drain catches exactly one producer chunk (the vectorized actor's
+    steady state: its fleet batch passes straight through to
+    ReplayBuffer.extend). Only the pop runs under the lock; the
+    concatenation works on the popped chunks outside it, so concurrent
+    put() is never blocked behind the copy.
 
     Returns None when the queue is empty (the per-step drain's common
     case, kept allocation-free).
     """
     with self._lock:
-      n = len(self._items) if max_items is None else min(
-          max_items, len(self._items))
-      items = [self._items.popleft() for _ in range(n)]
-      self.dequeued += n
-    if not items:
+      chunks, popped = self._pop_rows_locked(
+          self._rows if max_items is None else max_items)
+      self.dequeued += popped
+    if not chunks:
       return None
-    return {key: np.stack([item[key] for item in items])
-            for key in items[0]}
+    if len(chunks) == 1:
+      return chunks[0]
+    return {key: np.concatenate([chunk[key] for chunk in chunks])
+            for key in chunks[0]}
 
   def __len__(self) -> int:
     with self._lock:
-      return len(self._items)
+      return self._rows
 
   def stats(self) -> Dict[str, int]:
     with self._lock:
@@ -155,7 +235,7 @@ class TransitionQueue:
           "enqueued": self.enqueued,
           "dropped": self.dropped,
           "dequeued": self.dequeued,
-          "pending": len(self._items),
+          "pending": self._rows,
       }
 
 

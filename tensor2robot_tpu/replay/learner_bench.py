@@ -34,7 +34,11 @@ import numpy as np
 
 
 def _spread(values, digits=3):
-  """{median,min,max,trials} — bench.py's committed field shape."""
+  """{median,min,max,trials} — bench.py's committed field shape.
+
+  Shared with replay/actor_bench.py (as is `_synthetic_transitions`):
+  the learner and actor throughput blocks must carry the same citable
+  field shape, so there is exactly one definition of it here."""
   vals = [float(v) for v in values]
   return {
       "median": round(statistics.median(vals), digits),

@@ -156,6 +156,7 @@ class CollectorWorker:
     ]
     self.episodes = 0
     self.successes = 0
+    self.env_steps = 0
     self.errors: List[BaseException] = []
     self._stop = threading.Event()
     self._thread = threading.Thread(target=self._run, daemon=True)
@@ -205,6 +206,7 @@ class CollectorWorker:
     actions = np.where((draw < self._epsilon)[:, None], uniform, actions)
     actions = np.where(
         (draw >= 1.0 - self._scripted)[:, None], scripted, actions)
+    self.env_steps += len(self._envs)
     for env, record, action in zip(self._envs, self._records, actions):
       scene = env.image
       reward, done, truncated = env.step(np.asarray(action))
@@ -267,6 +269,20 @@ class ReplayLoopConfig:
   device_resident: bool = False
   megastep_inner: int = 10
   ingest_chunk: int = 64
+  # Vectorized actor fleet (ISSUE 5): replace the num_collectors scalar
+  # CollectorWorker threads (envs_per_collector envs each) with ONE
+  # VectorActor batching the SAME total env count through one fused CEM
+  # bucket executable, feeding the queue in fixed fleet-size chunks.
+  # Collection SEMANTICS (retry budget, exploration-mix fractions and
+  # per-step draw order, the scene-seed formula) are unchanged; the
+  # single actor draws from ONE seed stream (collector 0's base seed)
+  # where the threaded path runs num_collectors independent streams —
+  # bit-identity is pinned at the worker level (one fleet == N scalar
+  # envs sharing a stream, tests/test_actor.py), not against the
+  # threaded loop, whose scene assignment is thread-timing-dependent
+  # anyway. The threaded scalar path stays the default and the
+  # measured fallback.
+  vector_actors: bool = False
 
 
 class ReplayTrainLoop:
@@ -340,10 +356,17 @@ class ReplayTrainLoop:
   def _make_policy(self, predictor):
     from tensor2robot_tpu.serving.policy import CEMFleetPolicy
     c = self.config
+    ladder = None
+    if c.vector_actors:
+      # Pin the ladder to the actor batch: acting compiles EXACTLY one
+      # bucket executable (the ledger's cem_bucket_<N> == 1 claim), and
+      # the fleet batch never pads.
+      from tensor2robot_tpu.serving.bucketing import BucketLadder
+      ladder = BucketLadder((c.num_collectors * c.envs_per_collector,))
     return CEMFleetPolicy(
         predictor, action_size=c.action_size,
         num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
-        iterations=c.cem_iterations, seed=c.seed + 7)
+        iterations=c.cem_iterations, seed=c.seed + 7, ladder=ladder)
 
   def _eval_transitions(self):
     """Held-out random-action eval set WITH its analytic value targets.
@@ -412,6 +435,23 @@ class ReplayTrainLoop:
 
   def _start_collectors(self, policy) -> None:
     c = self.config
+    if c.vector_actors:
+      # The Sebulba-style actor side: one VectorActor batches every env
+      # the scalar path would spread over num_collectors threads. The
+      # actor list IS self._collectors — the shared shutdown/stat paths
+      # (episodes, successes, errors, request_stop/join) drive either
+      # worker kind unchanged.
+      from tensor2robot_tpu.replay.actor import ActorFleet
+      self._fleet = ActorFleet(
+          policy, self.queue, c.image_size,
+          total_envs=c.num_collectors * c.envs_per_collector,
+          max_attempts=c.max_attempts, seed=c.seed,
+          grasp_radius=c.grasp_radius,
+          exploration_epsilon=c.exploration_epsilon,
+          scripted_fraction=c.scripted_fraction)
+      self._collectors = self._fleet.actors
+      self._fleet.start()
+      return
     self._collectors = [
         CollectorWorker(policy, self.queue, c.image_size,
                         num_envs=c.envs_per_collector,
@@ -457,6 +497,9 @@ class ReplayTrainLoop:
         "queue": self.queue.stats(),
         "buffer": self.buffer.metrics(),
         "episodes_collected": sum(c_.episodes for c_ in self._collectors),
+        "env_steps_collected": sum(c_.env_steps
+                                   for c_ in self._collectors),
+        "vector_actors": self.config.vector_actors,
         "collector_success_rate": (
             sum(c_.successes for c_ in self._collectors)
             / max(1, sum(c_.episodes for c_ in self._collectors))),

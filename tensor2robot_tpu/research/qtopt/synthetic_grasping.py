@@ -34,7 +34,7 @@ loss but serves WORSE closed-loop, 18% vs 65% at radius 0.25.)
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -204,6 +204,144 @@ class GraspRetryEnv:
     return float(success), success, truncated
 
 
+class VectorGraspEnv:
+  """N GraspRetryEnvs stepped in lockstep as ONE vectorized call.
+
+  ISSUE 5 tentpole: the replay loop's scalar collectors step one
+  `GraspRetryEnv` transition at a time from Python threads, so actor
+  throughput is bounded by per-env Python work and GIL contention. This
+  env holds all N scenes as stacked arrays and computes the whole
+  fleet's grasp outcomes (`grasp_success`, attempt bookkeeping,
+  truncation) in one numpy call per control step — the batched-acting
+  half of the Podracer split (PAPERS.md, arXiv:2104.06272).
+
+  Semantics contract (property-tested in tests/test_actor.py): with the
+  same per-env seed stream, every observable — scene images, targets,
+  rewards, dones, truncations, episode/success counts, auto-reset
+  boundaries — is BIT-IDENTICAL to N scalar `GraspRetryEnv`s driven in
+  env order. Scene generation goes through the same
+  `sample_scenes(1, seed)` call per reset, so images match byte for
+  byte, not just statistically.
+
+  Auto-reset: `step(actions, seed_fn=...)` resets every terminal env in
+  env index order, drawing one seed per reset from `seed_fn` — the same
+  order the scalar collector loop resets its fleet, so a shared
+  monotonic scene counter produces the same scene assignment. The
+  returned reward/done/truncated arrays always describe the PRE-reset
+  attempt; callers snapshot `images` before stepping to build
+  transitions (the scene is static within an episode, so a terminal
+  transition's next_image is the OLD scene — bootstrap never leaks
+  across the reset).
+  """
+
+  def __init__(self, num_envs: int, image_size: int = 64,
+               max_attempts: int = 4, radius: float = GRASP_RADIUS,
+               num_distractors: int = 0, occlusion: bool = False):
+    if num_envs < 1:
+      raise ValueError(f"num_envs must be >= 1, got {num_envs}")
+    self.num_envs = num_envs
+    self._image_size = image_size
+    self._max_attempts = max_attempts
+    self._radius = radius
+    self._num_distractors = num_distractors
+    self._occlusion = occlusion
+    self._images: Optional[np.ndarray] = None
+    self._targets: Optional[np.ndarray] = None
+    self._attempts = np.zeros((num_envs,), np.int64)
+    self.episodes = 0
+    self.successes = 0
+
+  def reset(self, seeds: Sequence[int]) -> np.ndarray:
+    """Resets every env (env order); returns uint8 (N, S, S, 3) images."""
+    seeds = list(seeds)
+    if len(seeds) != self.num_envs:
+      raise ValueError(
+          f"need {self.num_envs} seeds, got {len(seeds)}")
+    self._images = np.empty(
+        (self.num_envs, self._image_size, self._image_size, 3), np.uint8)
+    self._targets = np.empty((self.num_envs, 2), np.float32)
+    for i, seed in enumerate(seeds):
+      self.reset_env(i, seed)
+    return self._images
+
+  def reset_env(self, i: int, seed: int) -> None:
+    """New scene for env `i` — the same sample_scenes(1, seed) call a
+    scalar GraspRetryEnv.reset(seed) makes, so scenes are bit-identical
+    given the same seed (the equivalence property the actor tests pin)."""
+    assert self._images is not None, "call reset() first"
+    images, targets = sample_scenes(
+        1, image_size=self._image_size, seed=seed,
+        num_distractors=self._num_distractors,
+        occlusion=self._occlusion)
+    self._images[i] = images[0]
+    self._targets[i] = targets[0]
+    self._attempts[i] = 0
+
+  @property
+  def images(self) -> np.ndarray:
+    assert self._images is not None, "call reset() first"
+    return self._images
+
+  @property
+  def targets(self) -> np.ndarray:
+    assert self._targets is not None, "call reset() first"
+    return self._targets
+
+  @classmethod
+  def from_scenes(cls, images: np.ndarray, targets: np.ndarray,
+                  max_attempts: int = 4,
+                  radius: float = GRASP_RADIUS) -> "VectorGraspEnv":
+    """Env over PRE-SAMPLED scenes (no re-rendering).
+
+    The vectorized `evaluate_grasp_policy` path needs the EXACT scene
+    set `sample_scenes(num_scenes, seed)` produces (one sequential-RNG
+    call) so vectorized and scalar evaluation see the same scenes for
+    the same seed — per-env seeding would generate different scenes.
+    """
+    images = np.asarray(images, np.uint8)
+    targets = np.asarray(targets, np.float32)
+    env = cls(num_envs=images.shape[0], image_size=images.shape[1],
+              max_attempts=max_attempts, radius=radius)
+    env._images = images.copy()
+    env._targets = targets.copy()
+    return env
+
+  def step(self, actions: np.ndarray,
+           seed_fn: Optional[Callable[[], int]] = None
+           ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One grasp attempt across the whole fleet (one vectorized call).
+
+    Args:
+      actions: (N, A) commanded grasps.
+      seed_fn: when given, every terminal env auto-resets (env index
+        order, one seed drawn per reset) and the episode/success
+        counters advance — the scalar collector loop's bookkeeping.
+
+    Returns:
+      (rewards, dones, truncated): float32 (N,) rewards/dones (done
+      mirrors success — only success terminates value; truncation
+      bootstraps) and bool (N,) truncation flags, all describing the
+      PRE-reset attempt.
+    """
+    assert self._images is not None, "call reset() first"
+    actions = np.asarray(actions)
+    if actions.shape[0] != self.num_envs:
+      raise ValueError(
+          f"need {self.num_envs} actions, got {actions.shape[0]}")
+    success = grasp_success(self._targets, actions, self._radius)
+    self._attempts += 1
+    truncated = (~success) & (self._attempts >= self._max_attempts)
+    rewards = success.astype(np.float32)
+    if seed_fn is not None:
+      terminal = success | truncated
+      if terminal.any():
+        self.episodes += int(terminal.sum())
+        self.successes += int(success.sum())
+        for i in np.nonzero(terminal)[0]:
+          self.reset_env(int(i), seed_fn())
+    return rewards, rewards.copy(), truncated.copy()
+
+
 def evaluate_grasp_policy(
     policy: Callable[[np.ndarray], np.ndarray],
     num_scenes: int = 100,
@@ -213,15 +351,26 @@ def evaluate_grasp_policy(
     image_transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
     num_distractors: int = 4,
     occlusion: bool = True,
+    vectorized: bool = False,
 ) -> Dict[str, float]:
   """Closed-loop grasp evaluation: scene → policy(image) → success.
 
   Args:
     policy: image → action (e.g. research.qtopt.cem.CEMPolicy over an
-      exported Q-function).
+      exported Q-function). With ``vectorized=True`` the policy instead
+      maps the STACKED (N, S, S, 3) batch to (N, A) actions (e.g.
+      serving.CEMFleetPolicy) and the scoring runs as one
+      ``VectorGraspEnv`` step — no per-scene Python loop.
     image_transform: converts the rendered uint8 image to the policy's
       wire format. Default: float32 in [0, 1] (the float-image models'
-      serving contract); pass identity for uint8_images models.
+      serving contract); pass identity for uint8_images models. Applied
+      to the whole stack at once on the vectorized path (numpy
+      elementwise transforms behave identically either way).
+    vectorized: batch the whole evaluation through ``VectorGraspEnv``.
+      Scenes come from the SAME ``sample_scenes(num_scenes, seed)``
+      call on both paths, so for a per-image-deterministic policy the
+      same seed yields the same success rate — asserted in
+      tests/test_actor.py.
 
   Returns {"success_rate", "mean_distance", "num_scenes"}.
   """
@@ -230,6 +379,21 @@ def evaluate_grasp_policy(
   images, targets = sample_scenes(num_scenes, image_size, seed,
                                   num_distractors=num_distractors,
                                   occlusion=occlusion)
+  if vectorized:
+    env = VectorGraspEnv.from_scenes(images, targets, max_attempts=1,
+                                     radius=radius)
+    actions = np.asarray(policy(image_transform(images)), np.float32)
+    rewards, _, _ = env.step(actions)
+    # float32 per-scene norms, float64 reduction: bit-identical to the
+    # scalar loop's float(np.linalg.norm(...)) accumulation, so the two
+    # paths return THE SAME numbers for the same seed, not just close.
+    distances = np.linalg.norm(actions[:, :2] - targets,
+                               axis=-1).astype(np.float64)
+    return {
+        "success_rate": float(rewards.sum()) / num_scenes,
+        "mean_distance": float(np.mean(distances)),
+        "num_scenes": float(num_scenes),
+    }
   successes = 0
   distances = []
   for image, target in zip(images, targets):

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tensor2robot_tpu.research.qtopt import cem
+from tensor2robot_tpu.serving import bucketing
 from tensor2robot_tpu.serving.bucketing import BucketLadder
 
 
@@ -34,7 +35,8 @@ class CEMFleetPolicy:
   Callable: ``policy(images, seeds=None) -> (n, action_size) actions``,
   n = len(images) <= ladder.max_batch. Without a device-resident entry
   (``predictor.device_fn``) the policy falls back to a host loop that
-  ships one ``predict_batched`` call per CEM iteration.
+  pads the request to its ladder bucket once and ships one ``predict``
+  call per CEM iteration at that single flat bucket shape.
   """
 
   def __init__(self, predictor, action_size: int = 4,
@@ -130,12 +132,28 @@ class CEMFleetPolicy:
   # -- host fallback -------------------------------------------------------
 
   def _host_call(self, batch: np.ndarray, seeds: np.ndarray) -> np.ndarray:
-    """predict_batched()-based fleet CEM: mirrors cem_optimize's sampling
-    per state (same fold_in sequence), so host and device paths agree
-    the way CEMPolicy's do; the flat (B*num_samples) scoring batch goes
-    through predict_batched, which bounds ITS executable count too."""
+    """predict()-based fleet CEM: mirrors cem_optimize's sampling per
+    state (same fold_in sequence), so host and device paths agree the
+    way CEMPolicy's do.
+
+    Shape discipline (ISSUE 5 satellite): the request batch is padded
+    to its ladder bucket ONCE, before the CEM loop — an exact-fit batch
+    (n already a ladder rung) is passed through with ZERO padding work
+    — and every per-iteration scoring call then carries the same
+    (bucket * num_samples) flat shape, so predict() sees exactly one
+    flat shape per bucket (the executable count stays ladder-bounded).
+    The old path re-derived a power-of-two bucket for the flat batch
+    inside predict_batched on EVERY CEM iteration, re-padding and
+    re-slicing the tiled image stack each time even when the request
+    count already fit a bucket exactly.
+    """
     num = self._num_samples
-    b = batch.shape[0]
+    n = batch.shape[0]
+    bucket = self.ladder.bucket_for(n)
+    if bucket != n:
+      batch = bucketing.pad_to(batch, bucket)
+      seeds = bucketing.pad_to(seeds, bucket)
+    b = bucket
     base = jax.random.key(self._seed)
     keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(
         jnp.asarray(seeds))
@@ -149,9 +167,9 @@ class CEMFleetPolicy:
           lambda k: jax.random.normal(k, (num, self._action_size)))(
               step_keys)
       samples = jnp.clip(mean[:, None] + std[:, None] * noise, -1.0, 1.0)
-      outputs = self._predictor.predict_batched({
+      outputs = self._predictor.predict({
           "image": tiled,
           "action": np.asarray(samples, np.float32).reshape(b * num, -1)})
       scores = jnp.asarray(outputs["q_predicted"]).reshape(b, num)
       mean, std = refit(samples, scores, self._num_elites)
-    return np.asarray(jnp.clip(mean, -1.0, 1.0))
+    return np.asarray(jnp.clip(mean, -1.0, 1.0))[:n]

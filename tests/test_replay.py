@@ -332,11 +332,67 @@ class TestIngest:
     np.testing.assert_array_equal(batch["action"][:, 0], [0.0, 1.0, 2.0])
     assert queue.stats()["dequeued"] == 3 and len(queue) == 2
 
+  def _batch(self, lo, hi):
+    items = [_transition(i) for i in range(lo, hi)]
+    return {key: np.stack([item[key] for item in items])
+            for key in items[0]}
+
+  def test_batched_put_counts_each_dropped_transition(self):
+    """ISSUE 5 satellite: a vector put that overflows sheds ROWS, not
+    batches — `dropped` counts every transition (the drop_rate health
+    metric is transition-denominated), and drop-oldest slices a chunk
+    mid-way rather than rounding the shed to chunk boundaries."""
+    queue = TransitionQueue(capacity=8)
+    assert queue.put_batch(self._batch(0, 6)) == 6
+    queue.put_batch(self._batch(6, 12))  # 4 rows over: 4 drops, not 1
+    stats = queue.stats()
+    assert stats == {"enqueued": 12, "dropped": 4, "dequeued": 0,
+                     "pending": 8}
+    # Survivors are the 8 newest rows, FIFO — the head chunk was
+    # sliced, not discarded whole.
+    batch = queue.drain_batch()
+    np.testing.assert_array_equal(batch["action"][:, 0],
+                                  np.arange(4, 12, dtype=np.float32))
+    # A put larger than capacity keeps only ITS newest rows and counts
+    # everything shed (its own head + all prior pending).
+    queue.put(_transition(99))
+    queue.put_batch(self._batch(0, 11))
+    stats = queue.stats()
+    assert stats["dropped"] == 4 + 1 + 3 and stats["pending"] == 8
+    assert stats["enqueued"] == (stats["dropped"] + stats["dequeued"]
+                                 + stats["pending"])
+
+  def test_empty_episode_is_a_noop(self):
+    """A zero-transition episode (a reset with no steps yet) enqueues
+    nothing — the pre-chunking loop contract."""
+    queue = TransitionQueue(capacity=4)
+    assert queue.put_episode({
+        "images": np.zeros((1, 2, 2, 3), np.uint8),
+        "actions": np.zeros((0, 4), np.float32),
+        "rewards": np.zeros((0,), np.float32),
+        "dones": np.zeros((0,), np.float32)}) == 0
+    assert len(queue) == 0 and queue.stats()["enqueued"] == 0
+
+  def test_batched_and_scalar_puts_interleave_fifo(self):
+    """Chunked storage is an implementation detail: scalar puts,
+    episode puts, and vector puts interleave into one FIFO row stream
+    (drain slices chunks back into per-transition dicts)."""
+    queue = TransitionQueue(capacity=16)
+    queue.put(_transition(0))
+    queue.put_batch(self._batch(1, 4))
+    queue.put(_transition(4))
+    assert len(queue) == 5
+    drained = queue.drain(max_items=2)
+    assert [t["action"][0] for t in drained] == [0.0, 1.0]
+    batch = queue.drain_batch()
+    np.testing.assert_array_equal(batch["action"][:, 0], [2.0, 3.0, 4.0])
+
   def test_shed_accounting_under_concurrent_put_and_drain(self):
-    """ISSUE 4 satellite: the conservation law enqueued == dropped +
-    dequeued + pending must hold exactly while producers race the
-    batched drain path (the counters and the deque share one lock;
-    a miscount here silently corrupts the drop_rate health metric)."""
+    """ISSUE 4 satellite, extended to BATCHED producers (ISSUE 5): the
+    conservation law enqueued == dropped + dequeued + pending must hold
+    exactly while scalar and vector producers race the batched drain
+    path (the counters and the deque share one lock; a miscount here
+    silently corrupts the drop_rate health metric)."""
     import threading
     queue = TransitionQueue(capacity=16)
     per_thread, n_threads = 200, 4
@@ -344,6 +400,12 @@ class TestIngest:
     stop = threading.Event()
 
     def producer(tid):
+      if tid % 2:
+        # Vectorized actor shape: fixed-size put_batch chunks.
+        for i in range(0, per_thread, 5):
+          base = tid * per_thread + i
+          queue.put_batch(self._batch(base, base + 5))
+        return
       for i in range(per_thread):
         queue.put(_transition(tid * per_thread + i))
 

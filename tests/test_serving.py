@@ -253,10 +253,65 @@ class TestCEMFleetPolicy:
       assert np.argmin(distances) == i, (
           f"request {i} answered toward optimum {np.argmin(distances)}")
 
+  def test_host_call_exact_fit_skips_padding_and_executables(
+      self, tiny_predictor, monkeypatch):
+    """ISSUE 5 satellite: when the request count already equals a
+    ladder rung, the host fallback performs ZERO padding work (no
+    pad_to call, no copy) and scores every CEM iteration through ONE
+    flat shape per bucket — the old path re-derived a power-of-two
+    bucket for the flat (B*num_samples) batch inside predict_batched
+    on EVERY iteration, re-padding and re-slicing each time."""
+    from tensor2robot_tpu.serving import bucketing
+    from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+
+    pad_sizes = []
+    real_pad_to = bucketing.pad_to
+
+    def spying_pad_to(batch, size):
+      pad_sizes.append(size)
+      return real_pad_to(batch, size)
+
+    monkeypatch.setattr(bucketing, "pad_to", spying_pad_to)
+    flat_sizes = []
+
+    class HostOnly:
+      def __init__(self, inner):
+        self._inner = inner
+
+      def device_fn(self):
+        raise NotImplementedError
+
+      def predict(self, features):
+        flat_sizes.append(np.asarray(features["image"]).shape[0])
+        return self._inner.predict(features)
+
+      def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    iterations, num = 3, 32
+    policy = CEMFleetPolicy(HostOnly(tiny_predictor), action_size=4,
+                            num_samples=num, num_elites=4,
+                            iterations=iterations, seed=3)
+    images = [tiny_predictor.make_image(i) for i in range(4)]
+    actions = policy(images)  # 4 is a ladder rung: exact fit
+    assert actions.shape == (4, 4)
+    assert pad_sizes == []  # no padding work at exact fit
+    # One flat scoring shape (one executable's worth of work), one
+    # call per CEM iteration — nothing extra.
+    assert flat_sizes == [4 * num] * iterations
+    # Non-exact fit pads ONCE up front (batch + seeds at the request
+    # level), never per iteration, and scores the same bucket shape.
+    pad_sizes.clear()
+    flat_sizes.clear()
+    assert policy(images[:3]).shape == (3, 4)
+    assert pad_sizes == [4, 4]
+    assert flat_sizes == [4 * num] * iterations
+
   def test_host_fallback_matches_device_path(self, tiny_predictor):
-    """Without device_fn the policy scores through predict_batched; the
-    sampling sequence mirrors the compiled path, so both agree (the
-    fleet version of CEMPolicy's device/host parity test)."""
+    """Without device_fn the policy pads to its bucket once and scores
+    through predict(); the sampling sequence mirrors the compiled path,
+    so both agree (the fleet version of CEMPolicy's device/host parity
+    test)."""
     from tensor2robot_tpu.serving.policy import CEMFleetPolicy
 
     class HostOnly:
