@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 8
+ROUND = 9
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -947,6 +947,27 @@ def _bench_actor_compact():
   return measure_actor_throughput()
 
 
+def _bench_anakin_compact():
+  """Anakin-throughput block for the bench detail (ISSUE 6).
+
+  Same driver-refreshable rationale as the serving/learner/actor
+  blocks: the committed replay artifact (REPLAY_SMOKE_r0N.json)
+  carries the chipless fused-vs-fleet comparison, but a driver-only
+  chip window should re-measure the fused act->step->extend->learn
+  executable against the numpy vector fleet on the real host+chip
+  pair. Runs replay/anakin_bench's comparison (same TinyQ critic, same
+  CEM hyperparameters, same env count on both paths; the headline
+  ratio co-schedules the megastep learner with the fleet — the r08
+  production shape — and the collect-only ratio rides along); every
+  citable field carries the {median,min,max,trials} spread, and the
+  block's `dtype` field is where the ROADMAP item 5 bf16 CEM tier
+  lands its precision ablation.
+  """
+  from tensor2robot_tpu.replay.anakin_bench import (
+      measure_anakin_throughput)
+  return measure_anakin_throughput()
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1088,6 +1109,11 @@ def main() -> None:
   except Exception as e:
     actor = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    anakin = _bench_anakin_compact()
+  except Exception as e:
+    anakin = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1144,6 +1170,7 @@ def main() -> None:
       "serving": serving,
       "learner": learner,
       "actor": actor,
+      "anakin": anakin,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1164,6 +1191,8 @@ def main() -> None:
       "learner_megastep_speedup": learner.get(
           "speedup", {}).get("median"),
       "actor_fleet_speedup": actor.get(
+          "speedup", {}).get("median"),
+      "anakin_env_steps_speedup": anakin.get(
           "speedup", {}).get("median"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,

@@ -20,16 +20,15 @@
 #     with round + UTC time) so any session can `tail` the same file.
 #
 # Stages (artifact -> producer):
-#   REPLAY_SMOKE_r0N.json        bin/run_qtopt_replay --smoke
-#                                --device-resident --vector-actors
+#   REPLAY_SMOKE_r0N.json        bin/run_qtopt_replay --smoke --anakin
 #                                (CHIPLESS backstop, runs before any
 #                                chip appears; normally builder-
-#                                committed and skipped — ISSUE 4/5.
-#                                This IS the actor-bench stage too: the
-#                                artifact's actor_throughput block
-#                                carries the vector-vs-threaded acting
-#                                ratio and the acting/learning overlap
-#                                fraction)
+#                                committed and skipped — ISSUE 4/5/6.
+#                                This IS the anakin-bench stage too:
+#                                the artifact's anakin_throughput block
+#                                carries the fused-vs-numpy-fleet env
+#                                rate, the host-blocked fraction, and
+#                                the CEM dtype field)
 #   BENCH_DETAIL_r0N.json        bench.py (orchestrator; also emits the
 #                                compact line, saved to BENCH_builder_r0N.json)
 #   SERVING_r0N.json             bin/bench_serving single-robot + --fleet lines
@@ -113,7 +112,7 @@ else
   done
   run_stage "REPLAY_SMOKE_${RTAG}.json" 1800 sh -c '
     python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke \
-      --device-resident --vector-actors --out "$STAGE_TMP"'
+      --anakin --out "$STAGE_TMP"'
 fi
 while [ "$(date +%s)" -lt "$deadline" ]; do
   # Never perturb a live test run: the probe's jax import is real CPU

@@ -11,6 +11,7 @@ train step — with the compiled-program ledger in the output.
     python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke --device-resident
     python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke \
         --device-resident --vector-actors
+    python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke --anakin
 
 `--device-resident` (ISSUE 4) keeps replay state on device and fuses
 K = megastep_inner sample→CEM-label→train→reprioritize iterations into
@@ -32,16 +33,29 @@ fallback. The output additionally carries an `actor_throughput` block
 policy and env count, and the acting/learning overlap fraction — the
 replay/actor_bench.py comparison; skip with `--no-actor-bench`).
 
+`--anakin` (ISSUE 6) fuses the WHOLE loop: the JAX-native grasping env
+(research/qtopt/jax_grasping.py), CEM acting, fixed-chunk replay
+extend, and the learner inner body compile into ONE donated executable
+(replay/anakin.py) scanning `anakin_inner` control steps per dispatch
+— no collector threads, no queue, zero host work in the steady state.
+The output carries an `anakin_throughput` block (fused vs numpy-fleet
+env steps/s at the same env count and policy — both in their full
+production shape, the collect-only baseline alongside — plus the
+host-blocked fraction and the CEM scoring `dtype`; skip with
+`--no-anakin-bench`). The vector-actor and threaded paths stay the
+measured fallbacks.
+
 Prints ONE JSON line (the repo's bench/driver contract): initial/final
 eval Bellman residual, the reduction fraction, replay health counters,
 and `compile_counts` (every value must be 1 — fixed-shape sampling
 never recompiles; on the device path that includes exactly one
-megastep executable, and with vector actors exactly one acting
-executable per bucket). `--smoke` is the chipless CI scale (tier-1
-asserts a >= 30% residual reduction on it); the default scale is the
-same loop with a bigger buffer/budget for on-chip runs. `--out`
-additionally writes the same JSON to a file (the committed smoke
-artifact, REPLAY_SMOKE_r08.json for this round).
+megastep executable, with vector actors exactly one acting executable
+per bucket, and with --anakin exactly one fused anakin_step
+executable). `--smoke` is the chipless CI scale (tier-1 asserts a
+>= 30% residual reduction on it); the default scale is the same loop
+with a bigger buffer/budget for on-chip runs. `--out` additionally
+writes the same JSON to a file (the committed smoke artifact,
+REPLAY_SMOKE_r09.json for this round).
 """
 
 from __future__ import annotations
@@ -53,11 +67,11 @@ import tempfile
 
 
 def build_config(smoke: bool, seed: int, device_resident: bool = False,
-                 vector_actors: bool = False):
+                 vector_actors: bool = False, anakin: bool = False):
   from tensor2robot_tpu.replay.loop import ReplayLoopConfig
   if smoke:
     return ReplayLoopConfig(seed=seed, device_resident=device_resident,
-                            vector_actors=vector_actors)
+                            vector_actors=vector_actors, anakin=anakin)
   return ReplayLoopConfig(
       image_size=64, batch_size=32, capacity=50_000, min_fill=2_000,
       num_buffer_shards=4, num_collectors=4, envs_per_collector=8,
@@ -65,14 +79,17 @@ def build_config(smoke: bool, seed: int, device_resident: bool = False,
       cem_iterations=3, refresh_every=200, eval_every=500,
       eval_batches=8, log_every=50, learning_rate=1e-4, seed=seed,
       device_resident=device_resident, megastep_inner=50,
-      ingest_chunk=256, vector_actors=vector_actors)
+      ingest_chunk=256, vector_actors=vector_actors, anakin=anakin,
+      anakin_inner=200, anakin_bank_scenes=4096)
 
 
 def run(steps: int, smoke: bool, logdir: str, seed: int,
         device_resident: bool = False, learner_bench: bool = True,
-        vector_actors: bool = False, actor_bench: bool = True) -> dict:
+        vector_actors: bool = False, actor_bench: bool = True,
+        anakin: bool = False, anakin_bench: bool = True) -> dict:
   from tensor2robot_tpu.replay.loop import ReplayTrainLoop
-  config = build_config(smoke, seed, device_resident, vector_actors)
+  config = build_config(smoke, seed, device_resident, vector_actors,
+                        anakin)
   model = None  # default: the flagship QTOptGraspingModel
   if smoke:
     # CI-scale critic (replay/smoke.py): the flagship's conv tower
@@ -117,6 +134,24 @@ def run(steps: int, smoke: bool, logdir: str, seed: int,
         cem_num_elites=config.cem_num_elites,
         cem_iterations=config.cem_iterations,
         batch_size=config.batch_size, gamma=config.gamma, seed=seed)
+  if anakin and anakin_bench:
+    # The ISSUE 6 acceptance block: fused-anakin vs numpy-vector-fleet
+    # env throughput at the same env count and policy, plus the fused
+    # loop's host-blocked fraction (replay/anakin_bench).
+    from tensor2robot_tpu.replay.anakin_bench import (
+        measure_anakin_throughput)
+    results["anakin_throughput"] = measure_anakin_throughput(
+        image_size=config.image_size if smoke else 16,
+        action_size=config.action_size,
+        max_attempts=config.max_attempts,
+        grasp_radius=config.grasp_radius,
+        exploration_epsilon=config.exploration_epsilon,
+        scripted_fraction=config.scripted_fraction,
+        cem_num_samples=config.cem_num_samples,
+        cem_num_elites=config.cem_num_elites,
+        cem_iterations=config.cem_iterations,
+        train_every=config.anakin_train_every,
+        batch_size=config.batch_size, gamma=config.gamma, seed=seed)
   results["mode"] = "smoke" if smoke else "full"
   results["metric"] = ("QT-Opt off-policy replay loop: eval Bellman "
                        "residual reduction")
@@ -143,6 +178,15 @@ def main(argv=None) -> None:
   parser.add_argument("--no-actor-bench", action="store_true",
                       help="skip the actor_throughput comparison "
                            "block on --vector-actors runs")
+  parser.add_argument("--anakin", action="store_true",
+                      help="fully fused Anakin loop: JAX-native env + "
+                           "acting + replay extend + learner in ONE "
+                           "donated executable (replay/anakin.py); "
+                           "the vector-actor and threaded paths stay "
+                           "the measured fallbacks")
+  parser.add_argument("--no-anakin-bench", action="store_true",
+                      help="skip the anakin_throughput comparison "
+                           "block on --anakin runs")
   parser.add_argument("--logdir", default=None,
                       help="metric_writer logdir (default: a tempdir)")
   parser.add_argument("--seed", type=int, default=0)
@@ -159,7 +203,9 @@ def main(argv=None) -> None:
                 device_resident=args.device_resident,
                 learner_bench=not args.no_learner_bench,
                 vector_actors=args.vector_actors,
-                actor_bench=not args.no_actor_bench)
+                actor_bench=not args.no_actor_bench,
+                anakin=args.anakin,
+                anakin_bench=not args.no_anakin_bench)
   line = json.dumps(results)
   if args.out:
     with open(args.out, "w") as f:

@@ -47,6 +47,37 @@ class CriticModel(AbstractT2RModel):
       return jax.nn.sigmoid(q.astype(jnp.float32))
     return q
 
+  def factored_cem_fns(self):
+    """Optional factored scoring pair for fused CEM consumers.
+
+    CEM scores ONE state against many candidate actions, but the tiled
+    score contract (cem.make_tiled_q_score_fn) re-runs the whole
+    (image + action) forward per candidate — for image-tower-heavy
+    critics, num_samples copies of identical image work per state per
+    CEM iteration. A module that can split the action-independent
+    prefix exposes `encode(features) -> code` and
+    `q_from_code({"image": code, "action": actions})`; consumers then
+    encode each state once and run CEM over the (cheap-to-tile) code —
+    the same Q function, the image tower hoisted out of the search
+    loop (replay/anakin.py measures the win; the generic tiled path
+    stays the default everywhere else).
+
+    Returns (encode_fn, q_from_code_fn) with predict_fn-shaped
+    signatures (variables first), or None when the module has no
+    factored form — callers must fall back to the tiled score.
+    """
+    module = self.module
+    if not (hasattr(module, "encode") and hasattr(module, "q_from_code")):
+      return None
+
+    def encode_fn(variables, features):
+      return module.apply(variables, features, method=module.encode)
+
+    def q_from_code_fn(variables, features):
+      return module.apply(variables, features, method=module.q_from_code)
+
+    return encode_fn, q_from_code_fn
+
   def loss_fn(
       self,
       outputs,

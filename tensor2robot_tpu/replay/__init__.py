@@ -28,6 +28,12 @@ set, host-RAM replay:
   feeding the queue in fixed fleet-size chunks, double-buffered
   against the megastep learner (``ReplayLoopConfig.vector_actors``;
   the threaded CollectorWorker path is the fallback);
+- ``AnakinLoop`` (anakin.py, ISSUE 6): the whole production loop —
+  JAX-native env (`research/qtopt/jax_grasping.JaxGraspEnv`), CEM
+  acting, fixed-chunk replay extend, and the learner inner body —
+  fused into ONE donated executable scanning K control steps with
+  zero host work in the steady state (``ReplayLoopConfig.anakin``;
+  the vector-actor and threaded paths are the measured fallbacks);
 - ``ReplayTrainLoop`` (loop.py): async collect -> replay -> train
   driver wiring serving's CEMFleetPolicy collectors, the buffer, the
   updater, and train/trainer.py together, with replay-health metrics
@@ -37,6 +43,7 @@ Entry point: ``python -m tensor2robot_tpu.bin.run_qtopt_replay``.
 """
 
 from tensor2robot_tpu.replay.actor import ActorFleet, VectorActor
+from tensor2robot_tpu.replay.anakin import AnakinLoop
 from tensor2robot_tpu.replay.bellman import BellmanUpdater
 from tensor2robot_tpu.replay.device_buffer import (DeviceReplayBuffer,
                                                    DeviceReplayState,
@@ -51,6 +58,7 @@ from tensor2robot_tpu.replay.sum_tree import SumTree
 
 __all__ = [
     "ActorFleet",
+    "AnakinLoop",
     "BellmanUpdater",
     "CollectorWorker",
     "DeviceReplayBuffer",

@@ -1,0 +1,333 @@
+"""AnakinLoop: act→env-step→extend→learn fused into ONE executable.
+
+ISSUE 6 tentpole, second half. PR 3 fused the learner (MegastepLearner)
+and PR 4 batched the actors (VectorActor), but the two halves still
+meet on the HOST: the actor dispatches a CEM executable per control
+step, steps numpy, enqueues, and the feeder re-stages the same bytes
+back to the device — at ~3.7k env steps/s the loop is bounded by that
+host choreography, not by any compiled program. This module is the
+full Anakin architecture from Podracer (PAPERS.md, arXiv:2104.06272):
+environment, action selection, replay extend, AND the optimizer step
+all live inside one donated AOT executable that lax.scans K control
+steps per dispatch. In the steady state the host's only work is
+reading back a handful of scalar metrics and promoting checkpoints —
+and because the whole loop is one jitted program, it later shards over
+the dp×tp mesh like any other step (arXiv:2204.06514), which is what
+unblocks ROADMAP open item 1.
+
+Per scanned control step:
+
+  obs       = env_state.images                (uint8, pre-step snapshot)
+  act       : CEM through the SAME fleet_cem_optimize /
+              make_tiled_q_score_fn contract serving uses, on the LIVE
+              online params (strictly fresher than the actors' hot
+              reload); models exposing `factored_cem_fns` encode each
+              scene once and search over the code (identical Q, the
+              image tower hoisted out of the sample loop), plus the
+              collectors' epsilon-uniform + scripted-near-object
+              exploration mix — same fractions and per-step draw
+              order, drawn from JAX RNG instead of the numpy stream.
+  env step  : jax_grasping.JaxGraspEnv.step_fn (pure; lax.select
+              auto-reset; property-tested bit-identical to the numpy
+              oracle).
+  extend    : DeviceReplayBuffer.extend_fn at ONE fixed chunk — the
+              fleet width — so the ring ingests in place with no
+              recompile and no host staging (next_image == image: the
+              scene is static within an episode, the numpy collectors'
+              transition recipe).
+  learn     : every `train_every`-th step, gated on min-fill via
+              lax.cond, the EXACT megastep inner body
+              (device_buffer.make_learn_iteration_fn): sample →
+              CEM-Bellman label vs the target net → Trainer
+              grad/apply → TD → in-place reprioritize.
+
+The target network stays an executable ARGUMENT (refresh never
+recompiles) and ``compile_counts['anakin_step']`` extends the replay
+ledger: exactly one fused executable for the life of the loop. The
+min-fill gate lives INSIDE the program (buffer size test), so there is
+no host-side warm-up phase either — dispatch 0 already runs the final
+steady-state code path.
+
+Determinism: acting, exploration, env-reset, sampling, and label
+randomness are all pure functions of (seed, outer, inner[, position])
+via fold_in — one dispatch stream is replayable and independent of
+wall-clock or host state.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.replay.bellman import (TargetNetwork,
+                                             make_bellman_targets_fn,
+                                             make_cem_states_and_score)
+from tensor2robot_tpu.replay.device_buffer import (DeviceReplayBuffer,
+                                                   make_learn_iteration_fn)
+from tensor2robot_tpu.research.qtopt import cem
+from tensor2robot_tpu.research.qtopt.jax_grasping import JaxGraspEnv
+
+
+class AnakinLoop(TargetNetwork):
+  """The fused act→step→extend→learn loop around a JaxGraspEnv.
+
+  Args:
+    model/trainer/buffer: the MegastepLearner trio; the buffer's
+      `ingest_chunk` MUST equal the env fleet width (one extend shape).
+    env: a JaxGraspEnv (bank or procedural scene source).
+    inner_steps: env control steps per dispatch (the scan length K).
+    train_every: optimizer steps fire every `train_every`-th control
+      step (must divide inner_steps). The numpy loop trained on its own
+      thread at whatever cadence the box allowed; fused, the replay
+      ratio is an explicit, reproducible knob.
+    min_fill: optimizer steps are lax.cond-gated until the ring holds
+      this many transitions — the ReplayFeeder.ready() gate, moved
+      inside the program.
+    exploration_epsilon / scripted_fraction: the collectors' mix.
+  """
+
+  def __init__(
+      self,
+      model,
+      trainer,
+      buffer: DeviceReplayBuffer,
+      env: JaxGraspEnv,
+      action_size: int = 4,
+      gamma: float = 0.9,
+      num_samples: int = 32,
+      num_elites: int = 4,
+      iterations: int = 2,
+      inner_steps: int = 40,
+      train_every: int = 8,
+      min_fill: int = 0,
+      exploration_epsilon: float = 0.2,
+      scripted_fraction: float = 0.25,
+      seed: int = 0,
+      polyak_tau: Optional[float] = None,
+  ):
+    if inner_steps < 1 or train_every < 1 or inner_steps % train_every:
+      raise ValueError(
+          f"inner_steps {inner_steps} must be a positive multiple of "
+          f"train_every {train_every}")
+    if buffer.ingest_chunk != env.num_envs:
+      raise ValueError(
+          f"buffer ingest_chunk {buffer.ingest_chunk} must equal the "
+          f"env fleet width {env.num_envs}: the fused extend runs at "
+          "ONE fixed chunk shape — the fleet's")
+    super().__init__(polyak_tau=polyak_tau)
+    self._model = model
+    self._trainer = trainer
+    self._buffer = buffer
+    self._env = env
+    self._action_size = action_size
+    self._gamma = gamma
+    self._num_samples = num_samples
+    self._num_elites = num_elites
+    self._iterations = iterations
+    self.inner_steps = inner_steps
+    self.train_every = train_every
+    self.min_fill = min_fill
+    self._epsilon = exploration_epsilon
+    self._scripted = scripted_fraction
+    self._seed = seed
+    self._clip_targets = getattr(model, "loss_type",
+                                 "cross_entropy") == "cross_entropy"
+    # CEM scoring precision (detail["anakin"]["dtype"]; the bf16 tier
+    # of ROADMAP item 5 lands against this field).
+    self.dtype = "float32"
+    self.compile_counts: Dict[str, int] = {}
+    self._exec = None
+    self._outer = 0
+    self._env_state = env.init_state(jax.random.key(seed + 21))
+    # Device counters snapshot (dispatch granularity, no mid-scan D2H).
+    self.env_steps = 0
+    self.trained_steps = 0
+    # Cumulative wall time inside the fused executable (dispatch through
+    # the metrics D2H) — the bench's host_blocked_fraction denominator;
+    # host bookkeeping in step() deliberately falls OUTSIDE this clock.
+    self.exec_seconds = 0.0
+
+  # --- fleet bookkeeping (ActorFleet-shaped instruments) -------------------
+
+  @property
+  def episodes(self) -> int:
+    return int(jax.device_get(self._env_state.episodes))
+
+  @property
+  def successes(self) -> int:
+    return int(jax.device_get(self._env_state.successes))
+
+  # --- the fused program ---------------------------------------------------
+
+  def _build_anakin_fn(self):
+    model = self._model
+    env_step = self._env.step_fn()
+    extend = self._buffer.extend_fn()
+    sample = self._buffer.sample_fn()
+    update_priorities = self._buffer.update_priorities_fn()
+    factored = getattr(model, "factored_cem_fns", lambda: None)()
+    targets_fn = make_bellman_targets_fn(
+        model, self._action_size, self._gamma, self._num_samples,
+        self._num_elites, self._iterations, self._clip_targets,
+        factored=factored is not None)
+    learn = make_learn_iteration_fn(
+        model, self._trainer.train_step_fn(), sample, update_priorities,
+        targets_fn, getattr(model, "target_key", "target_q"),
+        self._clip_targets)
+    n = self._env.num_envs
+    batch_size = self._buffer.sample_batch_size
+    k = self.inner_steps
+    train_every = self.train_every
+    min_fill = self.min_fill
+    epsilon = self._epsilon
+    scripted_fraction = self._scripted
+    cem_kwargs = dict(num_samples=self._num_samples,
+                      num_elites=self._num_elites,
+                      iterations=self._iterations)
+    action_size = self._action_size
+    act_base = jax.random.key(self._seed + 7)
+    explore_base = jax.random.key(self._seed + 555)
+    env_base = jax.random.key(self._seed + 31)
+    sample_base = jax.random.key(self._seed)
+    label_base = jax.random.key(self._seed + 1)
+
+    def act(online_variables, obs, targets, tick):
+      """CEM + exploration mix for the whole fleet, on device."""
+      keys = jax.vmap(
+          lambda j: jax.random.fold_in(
+              jax.random.fold_in(act_base, tick), j))(
+                  jnp.arange(n, dtype=jnp.uint32))
+      states, score = make_cem_states_and_score(model, factored,
+                                                online_variables, obs)
+      best, _ = cem.fleet_cem_optimize(score, states, keys, action_size,
+                                       **cem_kwargs)
+      # The collectors' exploration recipe (actor.py VectorActor
+      # step_once): one epsilon draw per env, uniform actions, scripted
+      # near-object grasps from the oracle pose — same fractions and
+      # per-step draw order, from folded JAX keys instead of the shared
+      # numpy stream (formula-level parity; the ENV is the bit-exact
+      # contract, exploration is policy, not environment).
+      ekey = jax.random.fold_in(explore_base, tick)
+      dkey, ukey, nkey = jax.random.split(ekey, 3)
+      draw = jax.random.uniform(dkey, (n,))
+      uniform = jax.random.uniform(ukey, (n, action_size), jnp.float32,
+                                   -1.0, 1.0)
+      noise = jax.random.normal(nkey, (n, 2), jnp.float32) * 0.12
+      scripted = uniform.at[:, :2].set(
+          jnp.clip(targets + noise, -1.0, 1.0))
+      actions = jnp.where((draw < epsilon)[:, None], uniform, best)
+      return jnp.where((draw >= 1.0 - scripted_fraction)[:, None],
+                       scripted, actions)
+
+    zero_metrics = {
+        "loss": jnp.zeros((), jnp.float32),
+        "td_error": jnp.zeros((), jnp.float32),
+        "q_next": jnp.zeros((), jnp.float32),
+        "staleness": jnp.zeros((), jnp.float32),
+    }
+
+    def anakin_step(train_state, env_state, buffer_state,
+                    target_variables, outer_step):
+
+      def body(carry, inner):
+        train_state, env_state, buffer_state, last_metrics = carry
+        tick = outer_step * jnp.int32(k) + inner
+        obs = env_state.images  # PRE-step snapshot: the observation
+        actions = act(train_state.variables(use_ema=True), obs,
+                      env_state.targets, tick)
+        env_state, (rewards, dones, _) = env_step(
+            env_state, actions, jax.random.fold_in(env_base, tick))
+        # Static scene: next_image == image; truncation already
+        # bootstraps through done=0 (the env's contract).
+        buffer_state = extend(buffer_state, {
+            "image": obs,
+            "action": actions.astype(jnp.float32),
+            "reward": rewards,
+            "done": dones,
+            "next_image": obs,
+        })
+        do_train = jnp.logical_and(
+            buffer_state.size >= min_fill,
+            (inner + 1) % train_every == 0)
+
+        def run_learn(train_state, buffer_state):
+          skey = jax.random.fold_in(sample_base, tick)
+          label_keys = jax.vmap(
+              lambda j: jax.random.fold_in(
+                  jax.random.fold_in(label_base, tick), j))(
+                      jnp.arange(batch_size, dtype=jnp.uint32))
+          return learn(train_state, buffer_state, target_variables,
+                       skey, label_keys)
+
+        def skip_learn(train_state, buffer_state):
+          return train_state, buffer_state, zero_metrics
+
+        train_state, buffer_state, metrics = jax.lax.cond(
+            do_train, run_learn, skip_learn, train_state, buffer_state)
+        # Keep the LAST TRAINED metrics (skipped steps report zeros).
+        last_metrics = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(do_train, new, old),
+            metrics, last_metrics)
+        trained = do_train.astype(jnp.int32)
+        return (train_state, env_state, buffer_state,
+                last_metrics), trained
+
+      (train_state, env_state, buffer_state, metrics), trained = (
+          jax.lax.scan(
+              body,
+              (train_state, env_state, buffer_state, zero_metrics),
+              jnp.arange(k, dtype=jnp.int32)))
+      metrics = dict(metrics)
+      metrics["trained_steps"] = jnp.sum(trained)
+      return train_state, env_state, buffer_state, metrics
+
+    return anakin_step
+
+  def compiled(self, train_state):
+    """The fused executable, AOT-compiled once (ledger: exactly 1).
+
+    Donates (train_state, env_state, buffer_state): params, opt state,
+    the episode state, the replay storage, and the sum tree all update
+    in place in device memory — the donation + fixed-shape discipline
+    of arXiv:2204.06514 applied to the WHOLE production loop.
+    """
+    if self._exec is None:
+      args = (train_state, self._env_state, self._buffer.state,
+              self._target_variables, jnp.zeros((), jnp.int32))
+      self._exec = jax.jit(
+          self._build_anakin_fn(),
+          donate_argnums=(0, 1, 2)).lower(*args).compile()
+      self.compile_counts["anakin_step"] = (
+          self.compile_counts.get("anakin_step", 0) + 1)
+    return self._exec
+
+  def step(self, train_state):
+    """One dispatch = `inner_steps` control steps (and up to
+    inner_steps / train_every optimizer steps, min-fill permitting).
+    Returns (train_state', metrics) with metrics as host floats — the
+    only D2H of the steady state.
+    """
+    if self._target_variables is None:
+      raise ValueError("call refresh(variables, step=0) before step()")
+    exec_ = self.compiled(train_state)
+    t0 = time.perf_counter()
+    train_state, env_state, buffer_state, metrics = exec_(
+        train_state, self._env_state, self._buffer.state,
+        self._target_variables, jnp.asarray(self._outer, jnp.int32))
+    # device_get blocks until the fused program finishes: the clock
+    # stops exactly at the end of device work + the scalar D2H, so the
+    # bookkeeping below is measurable host time, not hidden inside the
+    # "in executable" bucket.
+    metrics = jax.device_get(metrics)
+    self.exec_seconds += time.perf_counter() - t0
+    self._env_state = env_state
+    self._buffer.set_state(buffer_state)
+    self._outer += 1
+    self.env_steps += self.inner_steps * self._env.num_envs
+    host_metrics = {key: float(value) for key, value in metrics.items()}
+    host_metrics["trained_steps"] = int(host_metrics["trained_steps"])
+    self.trained_steps += host_metrics["trained_steps"]
+    return train_state, host_metrics

@@ -45,9 +45,28 @@ def q_value_from_logits(logits: jnp.ndarray,
   return jax.nn.sigmoid(logits) if clip_targets else logits
 
 
+def make_cem_states_and_score(model, fns, variables, images):
+  """The ONE CEM scoring recipe: (states, score_fn) for
+  fleet_cem_optimize, tiled or factored.
+
+  Acting (replay/anakin.py) and Bellman labeling (targets_fn below)
+  both build their search through this helper, so the
+  encode-once-then-score-the-code factored form can never drift from
+  the tiled contract in one consumer but not the other. `fns` is the
+  model's `factored_cem_fns()` result (None → tiled: score full images
+  through predict_fn; (encode_fn, q_from_code_fn) → encode each image
+  once and score codes)."""
+  if fns is None:
+    return images, cem.make_tiled_q_score_fn(model.predict_fn, variables)
+  encode_fn, q_from_code_fn = fns
+  return (encode_fn(variables, {"image": images}),
+          cem.make_tiled_q_score_fn(q_from_code_fn, variables))
+
+
 def make_bellman_targets_fn(model, action_size: int, gamma: float,
                             num_samples: int, num_elites: int,
-                            iterations: int, clip_targets: bool):
+                            iterations: int, clip_targets: bool,
+                            factored: bool = False):
   """THE Bellman target body, as one pure jittable closure.
 
   (target_variables, next_images, rewards, dones, keys) ->
@@ -58,11 +77,27 @@ def make_bellman_targets_fn(model, action_size: int, gamma: float,
   (replay/device_buffer.MegastepLearner) compile THIS function — the
   target recipe cannot silently diverge between the two learners, the
   exact failure mode the tiled-score contract exists to prevent.
+
+  factored=True (requires `model.factored_cem_fns()`): each next-state
+  image is encoded ONCE and the CEM max runs over the code through the
+  SAME make_tiled_q_score_fn / fleet_cem_optimize pair — identical Q
+  function and search, the image tower hoisted out of the sample loop
+  (the fused Anakin loop's configuration; equivalence to the tiled
+  recipe is property-tested in tests/test_anakin.py). The default
+  stays the tiled score: the one contract every learner shares.
   """
+  fns = model.factored_cem_fns() if factored else None
+  if factored and fns is None:
+    raise ValueError(
+        f"{type(model).__name__} has no factored CEM form "
+        "(factored_cem_fns() returned None); use factored=False")
+
   def targets_fn(target_variables, next_images, rewards, dones, keys):
-    score = cem.make_tiled_q_score_fn(model.predict_fn, target_variables)
+    states, score = make_cem_states_and_score(model, fns,
+                                              target_variables,
+                                              next_images)
     _, best_logits = cem.fleet_cem_optimize(
-        score, next_images, keys, action_size,
+        score, states, keys, action_size,
         num_samples=num_samples, num_elites=num_elites,
         iterations=iterations)
     q_next = q_value_from_logits(best_logits, clip_targets)

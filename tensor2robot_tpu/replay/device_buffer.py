@@ -528,6 +528,52 @@ class DeviceReplayBuffer:
     }
 
 
+def make_learn_iteration_fn(model, step_fn, sample, update_priorities,
+                            targets_fn, target_key, clip_targets):
+  """ONE sample→CEM-Bellman-label→train→reprioritize iteration as a
+  pure closure — THE learner inner body, extracted so the megastep
+  (which lax.scans it K times) and the fused Anakin loop
+  (replay/anakin.py, which interleaves it with acting/env/extend
+  inside one executable) compile the identical recipe; the target
+  formula cannot drift between the two fused learners any more than it
+  can between megastep and host updater.
+
+  (train_state, buffer_state, target_variables, sample_key,
+   label_keys) -> (train_state', buffer_state', metrics). RNG
+  derivation stays with the CALLER (each loop owns its key schedule);
+  this body is deterministic given the keys.
+  """
+
+  def learn(train_state, buffer_state, target_variables, sample_key,
+            label_keys):
+    batch, indices, _, staleness = sample(buffer_state, sample_key)
+    targets, q_next = targets_fn(
+        target_variables, batch["next_image"], batch["reward"],
+        batch["done"], label_keys)
+    features = {"image": batch["image"], "action": batch["action"]}
+    train_state, metrics = step_fn(train_state, features,
+                                   {target_key: targets})
+    # TD under the FRESH (post-update) params — host-loop parity:
+    # priorities reflect what the net thinks NOW.
+    outputs = model.predict_fn(
+        train_state.variables(use_ema=True),
+        {"image": batch["image"],
+         "action": batch["action"].astype(jnp.float32)})
+    q = q_value_from_logits(
+        jnp.reshape(outputs["q_predicted"], (-1,)), clip_targets)
+    td = jnp.abs(q - targets)
+    buffer_state = update_priorities(buffer_state, indices, td)
+    inner_metrics = {
+        "loss": metrics["loss"].astype(jnp.float32),
+        "td_error": jnp.mean(td),
+        "q_next": jnp.mean(q_next),
+        "staleness": jnp.mean(staleness.astype(jnp.float32)),
+    }
+    return train_state, buffer_state, inner_metrics
+
+  return learn
+
+
 class MegastepLearner(TargetNetwork):
   """K fused sample→label→train→reprioritize iterations per dispatch.
 
@@ -604,6 +650,10 @@ class MegastepLearner(TargetNetwork):
     sample_base = jax.random.key(self._seed)
     label_base = jax.random.key(self._seed + 1)
 
+    learn = make_learn_iteration_fn(model, step_fn, sample,
+                                    update_priorities, targets_fn,
+                                    target_key, clip)
+
     def megastep(train_state, buffer_state, target_variables,
                  outer_step, label_seed0):
 
@@ -613,7 +663,6 @@ class MegastepLearner(TargetNetwork):
         # replayable and independent of batch composition.
         skey = jax.random.fold_in(
             sample_base, outer_step * jnp.int32(k) + inner)
-        batch, indices, _, staleness = sample(buffer_state, skey)
         # CEM label keys: the host updater's monotonic uint32 counter,
         # continued exactly (one key per labelled transition ever).
         seeds = (label_seed0 + (inner * batch_size
@@ -621,28 +670,8 @@ class MegastepLearner(TargetNetwork):
                                     jnp.uint32)
         keys = jax.vmap(
             lambda s: jax.random.fold_in(label_base, s))(seeds)
-        targets, q_next = targets_fn(
-            target_variables, batch["next_image"], batch["reward"],
-            batch["done"], keys)
-        features = {"image": batch["image"], "action": batch["action"]}
-        train_state, metrics = step_fn(train_state, features,
-                                       {target_key: targets})
-        # TD under the FRESH (post-update) params — host-loop parity:
-        # priorities reflect what the net thinks NOW.
-        outputs = model.predict_fn(
-            train_state.variables(use_ema=True),
-            {"image": batch["image"],
-             "action": batch["action"].astype(jnp.float32)})
-        q = q_value_from_logits(
-            jnp.reshape(outputs["q_predicted"], (-1,)), clip)
-        td = jnp.abs(q - targets)
-        buffer_state = update_priorities(buffer_state, indices, td)
-        inner_metrics = {
-            "loss": metrics["loss"].astype(jnp.float32),
-            "td_error": jnp.mean(td),
-            "q_next": jnp.mean(q_next),
-            "staleness": jnp.mean(staleness.astype(jnp.float32)),
-        }
+        train_state, buffer_state, inner_metrics = learn(
+            train_state, buffer_state, target_variables, skey, keys)
         return (train_state, buffer_state), inner_metrics
 
       (train_state, buffer_state), metrics = jax.lax.scan(

@@ -283,6 +283,22 @@ class ReplayLoopConfig:
   # anyway. The threaded scalar path stays the default and the
   # measured fallback.
   vector_actors: bool = False
+  # Fused Anakin loop (ISSUE 6): the JAX-native grasping env
+  # (research/qtopt/jax_grasping.py) plus acting, replay extend, and
+  # the learner inner body fused into ONE donated executable
+  # (replay/anakin.py) — no collector threads, no queue, zero host
+  # work in the steady state. The env draws scenes from an
+  # oracle-rendered bank of `anakin_bank_scenes` (prerendered once at
+  # startup by the numpy semantics oracle, cycled thereafter); each
+  # dispatch scans `anakin_inner` control steps with one optimizer
+  # step every `anakin_train_every`-th CONTROL step — one control step
+  # advances the whole fleet, i.e. num_collectors * envs_per_collector
+  # env steps (min-fill gated INSIDE the program). The VectorActor
+  # path stays the measured fallback.
+  anakin: bool = False
+  anakin_inner: int = 40
+  anakin_train_every: int = 8
+  anakin_bank_scenes: int = 512
 
 
 class ReplayTrainLoop:
@@ -307,16 +323,30 @@ class ReplayTrainLoop:
     self.trainer = Trainer(self.model, seed=config.seed)
     self.writer = MetricWriter(logdir)
     spec = transition_spec(config.image_size, config.action_size)
-    if config.device_resident:
+    if config.device_resident or config.anakin:
       # The device ring IS the sharded buffer on this path: storage
       # shards over the capacity axis via the trainer's mesh (the
       # num_buffer_shards host striping exists to relieve a host lock
-      # the device path doesn't have).
+      # the device path doesn't have). The anakin loop pins the ingest
+      # chunk to the env fleet width: its fused extend runs at exactly
+      # that one shape, inside the executable.
       from tensor2robot_tpu.replay.device_buffer import DeviceReplayBuffer
+      chunk = (config.num_collectors * config.envs_per_collector
+               if config.anakin else config.ingest_chunk)
+      if config.anakin and config.capacity < chunk:
+        # DeviceReplayBuffer silently clamps ingest_chunk to capacity,
+        # which AnakinLoop would then reject with a chunk!=fleet error
+        # that names the wrong knob — diagnose the real one here.
+        raise ValueError(
+            f"anakin=True needs capacity >= the env fleet width "
+            f"(num_collectors {config.num_collectors} x "
+            f"envs_per_collector {config.envs_per_collector} = {chunk}): "
+            f"capacity {config.capacity} would clamp the fused extend "
+            "chunk below the fleet")
       self.buffer = DeviceReplayBuffer(
           spec, config.capacity, config.batch_size, seed=config.seed,
           prioritized=config.prioritized,
-          ingest_chunk=config.ingest_chunk, mesh=self.trainer.mesh)
+          ingest_chunk=chunk, mesh=self.trainer.mesh)
     elif config.num_buffer_shards > 1:
       self.buffer = ShardedReplayBuffer(
           spec, config.capacity, config.batch_size,
@@ -512,6 +542,8 @@ class ReplayTrainLoop:
 
   def run(self, num_steps: int) -> Dict:
     """Runs the closed loop for `num_steps` optimizer steps."""
+    if self.config.anakin:
+      return self._run_anakin(num_steps)
     if self.config.device_resident:
       return self._run_device_resident(num_steps)
     c = self.config
@@ -719,6 +751,122 @@ class ReplayTrainLoop:
         param_refreshes=learner.refresh_count - 1,  # minus cold-start
         device_resident=True,
         megastep_inner=k)
+
+  def _run_anakin(self, num_steps: int) -> Dict:
+    """The fully fused loop: act→env-step→extend→learn inside ONE
+    donated executable (replay/anakin.py) — no collector threads, no
+    queue, no host-side warm-up phase (the min-fill gate is a lax.cond
+    inside the program). The host dispatches, reads scalar metrics,
+    and runs the refresh/log/eval cadences between dispatches; it
+    stops once `num_steps` optimizer steps have actually fired
+    (warm-up dispatches collect without training, so dispatch count
+    adapts instead of undershooting the training budget).
+    """
+    from tensor2robot_tpu.replay.anakin import AnakinLoop
+    from tensor2robot_tpu.research.qtopt.jax_grasping import (
+        JaxGraspEnv, make_scene_bank)
+    c = self.config
+    total_envs = c.num_collectors * c.envs_per_collector
+    state = self.trainer.create_train_state(batch_size=c.batch_size)
+    host_variables = self._host_variables(state)
+    # EVAL-ONLY updater (device-path convention): the fused loop owns
+    # targets/TD; this only compiles the one TD-vs-analytic-Q* metric.
+    updater = BellmanUpdater(
+        self.model, host_variables, action_size=c.action_size,
+        gamma=c.gamma, num_samples=c.cem_num_samples,
+        num_elites=c.cem_num_elites, iterations=c.cem_iterations,
+        seed=c.seed + 13, polyak_tau=c.polyak_tau)
+    # Scene bank: the ONE-TIME host render (the oracle's own code);
+    # after this the host never touches a scene again.
+    bank = make_scene_bank(c.anakin_bank_scenes,
+                           image_size=c.image_size, base_seed=c.seed)
+    env = JaxGraspEnv(total_envs, image_size=c.image_size,
+                      max_attempts=c.max_attempts,
+                      radius=c.grasp_radius, bank=bank)
+    loop = AnakinLoop(
+        self.model, self.trainer, self.buffer, env,
+        action_size=c.action_size, gamma=c.gamma,
+        num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
+        iterations=c.cem_iterations, inner_steps=c.anakin_inner,
+        train_every=c.anakin_train_every, min_fill=c.min_fill,
+        exploration_epsilon=c.exploration_epsilon,
+        scripted_fraction=c.scripted_fraction, seed=c.seed + 13,
+        polyak_tau=c.polyak_tau)
+    loop.refresh(host_variables, step=0)
+
+    eval_batches, eval_q_stars = self._eval_transitions()
+    initial_eval = self._eval(updater, state.variables(use_ema=True),
+                              eval_batches, eval_q_stars)
+    self.writer.write_scalars(
+        0, {"replay/" + key: v for key, v in initial_eval.items()})
+
+    eval_history = [dict(step=0, **initial_eval)]
+    prev_step = 0
+    # Dispatch bound: warm-up (min-fill at total_envs per control
+    # step) plus the training budget, doubled — a failure to progress
+    # raises instead of spinning.
+    steps_per_dispatch = c.anakin_inner // c.anakin_train_every
+    max_dispatches = 2 * (
+        -(-c.min_fill // (total_envs * c.anakin_inner))
+        + -(-num_steps // steps_per_dispatch)) + 2
+    dispatches = 0
+    try:
+      while loop.trained_steps < num_steps:
+        if dispatches >= max_dispatches:
+          raise RuntimeError(
+              f"anakin loop stalled: {loop.trained_steps} optimizer "
+              f"steps after {dispatches} dispatches "
+              f"(min_fill={c.min_fill}, buffer size={self.buffer.size})")
+        state, metrics = loop.step(state)
+        dispatches += 1
+        step = loop.trained_steps
+        crossed = lambda every: (step // every) > (prev_step // every)
+        done = step >= num_steps
+
+        if crossed(c.refresh_every):
+          host_variables = self._host_variables(state)
+          loop.refresh(host_variables, step)
+          updater.refresh(host_variables, step)
+        if (crossed(c.log_every) or done) and metrics["trained_steps"]:
+          self.writer.write_scalars(step, {
+              "replay/train_loss": metrics["loss"],
+              "replay/train_td_error": metrics["td_error"],
+              "replay/train_q_next": metrics["q_next"],
+              "replay/sample_staleness": metrics["staleness"],
+              "replay/target_lag": float(loop.target_lag(step)),
+              "replay/episodes": float(loop.episodes),
+              "replay/env_steps": float(loop.env_steps),
+              **self.buffer.metrics(),
+          })
+        if crossed(c.eval_every) or done:
+          # Valid until the NEXT dispatch donates the state away.
+          online = state.variables(use_ema=True)
+          evals = self._eval(updater, online, eval_batches,
+                             eval_q_stars)
+          eval_history.append(dict(step=step, **evals))
+          self.writer.write_scalars(
+              step, {"replay/" + key: v for key, v in evals.items()})
+        prev_step = step
+    finally:
+      self.writer.close()
+
+    ledger = dict(self.compile_counts)
+    ledger.update(loop.compile_counts)
+    ledger.update(self.buffer.compile_counts)
+    ledger.update({f"bellman_{key}" if not key.startswith("bellman")
+                   else key: v
+                   for key, v in updater.compile_counts.items()})
+    return self._assemble_result(
+        loop.trained_steps, initial_eval, eval_history, ledger,
+        param_refreshes=loop.refresh_count - 1,  # minus cold-start
+        device_resident=True,
+        anakin=True,
+        anakin_inner=c.anakin_inner,
+        anakin_train_every=c.anakin_train_every,
+        episodes_collected=loop.episodes,
+        env_steps_collected=loop.env_steps,
+        collector_success_rate=(loop.successes
+                                / max(1, loop.episodes)))
 
   def _wait_for_min_fill(self) -> None:
     """Gates the first optimizer step on buffer warm-up (min-fill)."""

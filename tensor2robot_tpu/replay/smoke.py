@@ -37,22 +37,48 @@ SMOKE_ACTION_SIZE = 4
 
 
 class _TinyQModule(nn.Module):
-  """Flatten image → position code; action embed; joint MLP → q logit."""
+  """Flatten image → position code; action embed; joint MLP → q logit.
 
-  @nn.compact
-  def __call__(self, features, mode: str):
-    del mode  # no train/eval asymmetry (no dropout, no batch stats)
+  setup()-structured (same param names/shapes as the original compact
+  form — checkpoints interchange) so the image tower and the
+  action-conditioned head are separately callable: `encode` /
+  `q_from_code` back `CriticModel.factored_cem_fns`, letting fused CEM
+  consumers (replay/anakin.py) compute each scene's code ONCE per
+  control step instead of re-running the image tower on every tiled
+  candidate action — ~90% of this module's per-sample FLOPs are
+  image-side, so tiled scoring pays the tower num_samples times for
+  identical results."""
+
+  def setup(self):
+    self.img_fc1 = nn.Dense(64)
+    self.img_code = nn.Dense(32)
+    self.act_fc1 = nn.Dense(32)
+    self.joint_fc1 = nn.Dense(64)
+    self.joint_fc2 = nn.Dense(32)
+    self.q_head = nn.Dense(1)
+
+  def encode(self, features) -> jnp.ndarray:
+    """(B, S, S, 3) uint8 image wire → (B, 32) position code."""
     image = features["image"].astype(jnp.float32) / 255.0
     x = image.reshape((image.shape[0], -1))
-    x = nn.relu(nn.Dense(64, name="img_fc1")(x))
-    code = nn.Dense(32, name="img_code")(x)
-    action = nn.relu(nn.Dense(
-        32, name="act_fc1")(features["action"].astype(jnp.float32)))
-    h = jnp.concatenate([code, action], axis=-1)
-    h = nn.relu(nn.Dense(64, name="joint_fc1")(h))
-    h = nn.relu(nn.Dense(32, name="joint_fc2")(h))
-    q_logit = nn.Dense(1, name="q_head")(h)[:, 0]
-    return ts.TensorSpecStruct({"q_predicted": q_logit})
+    return self.img_code(nn.relu(self.img_fc1(x)))
+
+  def q_from_code(self, features):
+    """{"image": (B, 32) code, "action": (B, A)} → q logit (the
+    factored-score wire: the code rides the `image` key so the tiled
+    score_fn broadcast applies to it unchanged)."""
+    action = nn.relu(self.act_fc1(features["action"].astype(jnp.float32)))
+    h = jnp.concatenate([features["image"], action], axis=-1)
+    h = nn.relu(self.joint_fc1(h))
+    h = nn.relu(self.joint_fc2(h))
+    return ts.TensorSpecStruct({"q_predicted": self.q_head(h)[:, 0]})
+
+  def __call__(self, features, mode: str):
+    del mode  # no train/eval asymmetry (no dropout, no batch stats)
+    # The factored pair composed — the SAME ops in the same order as
+    # the pre-split module, so outputs are unchanged bit for bit.
+    return self.q_from_code({"image": self.encode(features),
+                             "action": features["action"]})
 
 
 class TinyQCriticModel(CriticModel):

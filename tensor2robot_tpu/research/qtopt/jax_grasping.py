@@ -1,0 +1,358 @@
+"""JaxGraspEnv: the synthetic grasping dynamics as pure jax.numpy.
+
+ISSUE 6 tentpole, first half: `VectorGraspEnv` vectorized the grasping
+fleet in numpy, which still forces a host<->device round-trip every
+control step — the actor dispatches one CEM executable, pulls actions
+to the host, steps numpy, and pushes transitions back. The Anakin
+architecture (Podracer, PAPERS.md arXiv:2104.06272) wants the
+environment INSIDE the compiled program, so act->step->extend->learn
+runs as one executable with zero host work in the steady state
+(replay/anakin.py). This module ports the env: a pure, jittable
+``step(state, actions, key)`` with per-env PRNG splits,
+``lax.select``-based auto-reset on terminal/truncation, and
+fixed-shape uint8 observations on the same image wire as the numpy
+env.
+
+Semantics oracle (PARITY r9): the numpy `VectorGraspEnv` /
+`GraspRetryEnv` pair remains the REFERENCE semantics; this env is
+property-tested BIT-IDENTICAL to it (tests/test_anakin.py) over
+matched seed streams, including auto-reset and truncation-bootstrap
+boundaries. Two scene sources keep that honest:
+
+  - ``SceneBank`` (the parity + production mode): scenes prerendered
+    ONCE on the host by the oracle's own `sample_scenes(1, seed)`
+    call, seeds drawn from the collector stream formula
+    (`base * 1_000_003 + counter`). On-device auto-reset assigns bank
+    rows in env-index order from a monotonic cursor — exactly the
+    scalar collectors' shared-seed-stream scene assignment — so
+    images, targets, outcomes, and episode bookkeeping match the
+    oracle byte for byte until the bank wraps (documented divergence:
+    the oracle keeps drawing fresh seeds; production runs just cycle).
+  - procedural (the domain-randomization substrate, ROADMAP item 4):
+    reset targets come from per-env `jax.random` splits and the scene
+    is rasterized ON DEVICE by `render_scenes` — unbounded fresh
+    scenes, no host in the loop at all.
+
+`render_scenes` reproduces the oracle rasterizer's decisions
+(pose_env.draw_disc runs in float64 on the host) from float32 device
+arithmetic via compensated (two_sum/two_prod) evaluation of the disc
+inequality — decisions accurate to ~2^-46 relative vs the oracle's
+2^-53, i.e. bit-identical except on a knife edge no fixed test corpus
+hits; tests/test_anakin.py pins exact equality on the committed
+corpus. The checker table + arm disc never change, so they are
+prerendered by the oracle code itself and only the target disc is
+decided on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.research.pose_env import pose_env
+from tensor2robot_tpu.research.qtopt.synthetic_grasping import (ACTION_SIZE,
+                                                                GRASP_RADIUS,
+                                                                sample_scenes)
+
+
+def scene_seed_stream(base_seed: int, count: int,
+                      start: int = 0) -> np.ndarray:
+  """The collector/actor scene-seed formula (`CollectorWorker._scene_seed`
+  verbatim: one monotonic counter, seed = base * 1_000_003 + counter) as
+  an array — the bank's seed source, so bank row j IS the scene the
+  numpy fleet's j-th reset would draw."""
+  return (base_seed * 1_000_003
+          + np.arange(start, start + count, dtype=np.int64))
+
+
+class SceneBank(flax.struct.PyTreeNode):
+  """Oracle-rendered scenes as device arrays (images uint8 (K, S, S, 3),
+  targets float32 (K, 2)). A pytree so it passes straight into compiled
+  programs as an ARGUMENT (device-resident after the first transfer,
+  never baked in as a constant)."""
+  images: jnp.ndarray
+  targets: jnp.ndarray
+
+  @property
+  def num_scenes(self) -> int:
+    return self.images.shape[0]
+
+
+def make_scene_bank(num_scenes: int, image_size: int = 64,
+                    base_seed: int = 0,
+                    seeds: Optional[np.ndarray] = None) -> SceneBank:
+  """Prerenders `num_scenes` oracle scenes (one `sample_scenes(1, seed)`
+  per row — the identical call a `GraspRetryEnv.reset(seed)` makes, so
+  every row is bit-identical to the scalar env's scene for that seed).
+  Host work happens ONCE here; the steady-state loop only gathers."""
+  if seeds is None:
+    seeds = scene_seed_stream(base_seed, num_scenes)
+  seeds = np.asarray(seeds).reshape(-1)
+  images = np.empty((len(seeds), image_size, image_size, 3), np.uint8)
+  targets = np.empty((len(seeds), 2), np.float32)
+  for i, seed in enumerate(seeds):
+    image, target = sample_scenes(1, image_size=image_size, seed=int(seed),
+                                  num_distractors=0, occlusion=False)
+    images[i], targets[i] = image[0], target[0]
+  return SceneBank(images=jnp.asarray(images), targets=jnp.asarray(targets))
+
+
+# --- compensated device rasterizer ----------------------------------------
+#
+# The oracle (pose_env.draw_disc) decides each pixel by
+#   (xx - cx)^2 + (yy - cy)^2 <= r^2     in float64,
+# cx = (tx + 1) / 2 * (S - 1) from the float32 target. Plain float32
+# evaluation flips boundary pixels (~1e-4 per scene — enough to break a
+# bit-identity test over a few hundred scenes), so the decision runs in
+# error-free-transformation pairs: two_sum/two_prod keep cx and the
+# squared distance exact to ~2^-46 relative, and the r^2 threshold is
+# fed as a float32 hi/lo pair of the host-computed float64 constant.
+
+
+def _two_sum(a, b):
+  """Knuth two-sum: a + b = s + e exactly (s = fl(a + b))."""
+  s = a + b
+  bb = s - a
+  return s, (a - bb) + (b - (s - bb))
+
+
+def _two_prod(a, b):
+  """Dekker product: a * b = p + e exactly (f32 split factor 2^12+1)."""
+  p = a * b
+  c = jnp.float32(4097.0) * a
+  ahi = c - (c - a)
+  alo = a - ahi
+  c = jnp.float32(4097.0) * b
+  bhi = c - (c - b)
+  blo = b - bhi
+  return p, ((ahi * bhi - p) + ahi * blo + alo * bhi) + alo * blo
+
+
+def _sq_dist_pair(grid, center):
+  """(grid - center)^2 as a hi/lo pair; grid integer-valued f32, center a
+  (hi, lo) pair. grid - center_hi is exact by Sterbenz-adjacent ranges
+  (both within a factor-2 band near cancellation; elsewhere the error is
+  far below the decision window)."""
+  chi, clo = center
+  dhi, de = _two_sum(grid, -chi)
+  dlo = de - clo
+  sq_hi, sq_e = _two_prod(dhi, dhi)
+  return sq_hi, sq_e + jnp.float32(2.0) * dhi * dlo
+
+
+def _pixel_center_pair(t, image_size):
+  """cx = (t + 1) / 2 * (S - 1) as a hi/lo pair, replicating the
+  oracle's float64 value (pose_env.pose_to_pixel) to ~2^-46."""
+  s, e = _two_sum(t, jnp.float32(1.0))
+  s, e = s * jnp.float32(0.5), e * jnp.float32(0.5)
+  scale = jnp.float32(image_size - 1)
+  p_hi, p_lo = _two_prod(s, scale)
+  hi, e2 = _two_sum(p_hi, e * scale)
+  return hi, p_lo + e2
+
+
+def _base_image(image_size: int) -> np.ndarray:
+  """The scene minus the target disc, rendered once at trace time. The
+  arm disc goes through the oracle's own draw_disc; the checker shading
+  REPLICATES PoseEnv.render's table block (same stride-8 pattern, same
+  +12 lift) rather than sharing code — the corpus parity test in
+  tests/test_anakin.py pins bit-exactness, so a pose_env texture change
+  surfaces there rather than drifting silently."""
+  s = image_size
+  image = np.empty((s, s, 3), np.uint8)
+  image[:] = pose_env.TABLE_COLOR
+  yy, xx = np.mgrid[0:s, 0:s]
+  image[((yy // 8 + xx // 8) % 2).astype(bool)] = tuple(
+      min(c + 12, 255) for c in pose_env.TABLE_COLOR)
+  pose_env.draw_disc(image, (0.0, -0.95), radius=0.12,
+                     color=pose_env.ARM_COLOR)
+  return image
+
+
+def _r2_pair(radius: float, image_size: int) -> Tuple[np.float32, np.float32]:
+  """The oracle's float64 r^2 threshold as a float32 hi/lo pair."""
+  r = np.float64(radius) / 2.0 * (image_size - 1)
+  r2 = r * r
+  hi = np.float32(r2)
+  return hi, np.float32(r2 - np.float64(hi))
+
+
+def make_render_fn(image_size: int, target_radius: float = 0.1):
+  """Jittable (targets (N, 2) f32) -> uint8 (N, S, S, 3) rasterizer for
+  the replay-loop scene (no distractors/occluder — the oracle's
+  `GraspRetryEnv` configuration). Used by the procedural mode; the
+  parity corpus test asserts it reproduces the oracle's images exactly."""
+  s = image_size
+  base = jnp.asarray(_base_image(s))
+  r2_hi, r2_lo = _r2_pair(target_radius, s)
+  grid = jnp.arange(s, dtype=jnp.float32)
+  color = jnp.asarray(pose_env.TARGET_COLOR, jnp.uint8)
+
+  def render(targets: jnp.ndarray) -> jnp.ndarray:
+    targets = targets.astype(jnp.float32)
+    cx = _pixel_center_pair(targets[:, 0], s)          # pixel x of target
+    # Pixel y grows downward: cy = (1 - (ty+1)/2) * (S-1) = (S-1) - cx(ty).
+    cy_raw = _pixel_center_pair(targets[:, 1], s)
+    cy = _two_sum(jnp.float32(s - 1), -cy_raw[0])
+    cy = (cy[0], cy[1] - cy_raw[1])
+    dx_hi, dx_lo = _sq_dist_pair(grid[None, None, :],
+                                 (cx[0][:, None, None], cx[1][:, None, None]))
+    dy_hi, dy_lo = _sq_dist_pair(grid[None, :, None],
+                                 (cy[0][:, None, None], cy[1][:, None, None]))
+    d_hi, d_e = _two_sum(dx_hi, dy_hi)
+    # Decision: sign of (dx^2 + dy^2) - r^2, leading terms cancel
+    # exactly, compensation terms decide the boundary.
+    diff = (d_hi - r2_hi) + ((d_e + dx_lo + dy_lo) - r2_lo)
+    mask = diff <= jnp.float32(0.0)
+    return jnp.where(mask[..., None], color[None, None, None, :],
+                     base[None])
+
+  return render
+
+
+# --- the env ---------------------------------------------------------------
+
+
+class JaxGraspState(flax.struct.PyTreeNode):
+  """The whole fleet's episode state as one device pytree.
+
+  images: uint8 (N, S, S, 3) current scene per env (the observation —
+    read BEFORE stepping, exactly the numpy actor's snapshot contract).
+  targets: float32 (N, 2) oracle object poses (scripted exploration and
+    grasp scoring read these on device; the numpy env exposes the same).
+  attempts: int32 (N,) grasps attempted in the current episode.
+  next_scene: int32 scalar — the monotonic scene cursor (the device
+    mirror of the collectors' shared seed-stream counter).
+  episodes / successes: int32 scalars (the fleet bookkeeping the
+    parity suite pins against the oracle's counters).
+
+  Deliberately NO PRNG key lives here: reset randomness (procedural
+  targets) comes from the key the caller passes to each step/init —
+  the fused loop derives it as fold_in(seed, tick), which keeps one
+  dispatch stream replayable without threading key state through the
+  donated pytree.
+  """
+  images: jnp.ndarray
+  targets: jnp.ndarray
+  attempts: jnp.ndarray
+  next_scene: jnp.ndarray
+  episodes: jnp.ndarray
+  successes: jnp.ndarray
+
+
+class JaxGraspEnv:
+  """N grasping envs stepped in lockstep as pure jittable functions.
+
+  Mirrors `VectorGraspEnv`'s auto-reset semantics exactly (the parity
+  suite's contract): rewards/dones/truncations describe the PRE-reset
+  attempt, done mirrors success only (truncation bootstraps), and every
+  terminal env resets immediately in env-index order — scene assignment
+  comes from the monotonic cursor into the bank, matching the scalar
+  seed stream. Scenes are static within an episode, so an episode's
+  next-observation is its own scene (the numpy collectors' transition
+  recipe); callers snapshot `state.images` before stepping.
+
+  Scene sources:
+    bank: `SceneBank` rows in cursor order, wrapping modulo the bank
+      size (parity-exact until the first wrap; size the bank to the
+      run, or accept scene reuse — a replay loop does).
+    procedural (`bank=None`): per-env PRNG split draws a fresh target
+      uniform in [-0.8, 0.8]^2 (the oracle's distribution) and
+      `render_scenes` rasterizes it on device.
+  """
+
+  def __init__(self, num_envs: int, image_size: int = 64,
+               max_attempts: int = 4, radius: float = GRASP_RADIUS,
+               bank: Optional[SceneBank] = None):
+    if num_envs < 1:
+      raise ValueError(f"num_envs must be >= 1, got {num_envs}")
+    if bank is not None and bank.images.shape[1] != image_size:
+      raise ValueError(
+          f"bank image size {bank.images.shape[1]} != env {image_size}")
+    self.num_envs = num_envs
+    self.image_size = image_size
+    self.max_attempts = max_attempts
+    self.radius = radius
+    self.bank = bank
+    self._render = make_render_fn(image_size)
+
+  # -- pure functions (what the fused loop compiles) ------------------------
+
+  def _fresh_scenes(self, slots: jnp.ndarray, keys: jax.Array):
+    """(targets, images) for reset envs: bank rows at `slots`, or
+    procedural draws from per-env keys."""
+    if self.bank is not None:
+      idx = slots % self.bank.num_scenes
+      return self.bank.targets[idx], self.bank.images[idx]
+    targets = jax.vmap(
+        lambda k: jax.random.uniform(k, (2,), jnp.float32, -0.8, 0.8))(keys)
+    return targets, self._render(targets)
+
+  def init_state(self, key: jax.Array) -> JaxGraspState:
+    """Every env reset once, scenes 0..N-1 in env order (the oracle
+    fleet's `reset([seed_fn() for _ in range(N)])`)."""
+    n = self.num_envs
+    _, init_key = jax.random.split(key)
+    targets, images = self._fresh_scenes(
+        jnp.arange(n, dtype=jnp.int32), jax.random.split(init_key, n))
+    return JaxGraspState(
+        images=images, targets=targets,
+        attempts=jnp.zeros((n,), jnp.int32),
+        next_scene=jnp.asarray(n, jnp.int32),
+        episodes=jnp.zeros((), jnp.int32),
+        successes=jnp.zeros((), jnp.int32))
+
+  def step_fn(self):
+    """Pure (state, actions, key) -> (state', (rewards, dones, truncated)).
+
+    One grasp attempt fleet-wide + lax.select auto-reset. The success
+    predicate replicates `grasp_success`'s float32 arithmetic exactly
+    (sqrt(dx^2 + dy^2) < radius, both float32, radius weakly typed) so
+    outcomes are bit-identical to the oracle's for identical actions.
+    """
+    n = self.num_envs
+    max_attempts = self.max_attempts
+    radius = self.radius
+
+    def step(state: JaxGraspState, actions: jnp.ndarray, key: jax.Array):
+      actions = actions.astype(jnp.float32)
+      delta = actions[:, :2] - state.targets
+      dist = jnp.sqrt(delta[:, 0] * delta[:, 0] + delta[:, 1] * delta[:, 1])
+      success = dist < radius
+      attempts = state.attempts + 1
+      truncated = jnp.logical_and(jnp.logical_not(success),
+                                  attempts >= max_attempts)
+      terminal = jnp.logical_or(success, truncated)
+      term32 = terminal.astype(jnp.int32)
+      # Env-index-order scene assignment: env i's reset takes cursor +
+      # (number of terminal envs before it) — the exact order the numpy
+      # fleet draws seeds from its shared monotonic counter.
+      order = jnp.cumsum(term32) - term32
+      slots = state.next_scene + order
+      new_targets, new_images = self._fresh_scenes(
+          slots, jax.random.split(key, n))
+      rewards = success.astype(jnp.float32)
+      state = state.replace(
+          images=jax.lax.select(
+              jnp.broadcast_to(terminal[:, None, None, None],
+                               state.images.shape),
+              new_images, state.images),
+          targets=jax.lax.select(
+              jnp.broadcast_to(terminal[:, None], state.targets.shape),
+              new_targets, state.targets),
+          attempts=jnp.where(terminal, 0, attempts),
+          next_scene=state.next_scene + jnp.sum(term32),
+          episodes=state.episodes + jnp.sum(term32),
+          successes=state.successes + jnp.sum(success.astype(jnp.int32)))
+      return state, (rewards, rewards, truncated)
+
+    return step
+
+  def render_scenes(self, targets: jnp.ndarray) -> jnp.ndarray:
+    """Device rasterizer for arbitrary targets (the procedural mode's
+    observation source); see make_render_fn for the exactness story."""
+    return self._render(targets)

@@ -1,0 +1,516 @@
+"""Fused Anakin loop + JAX-native grasping env (ISSUE 6 acceptance).
+
+Covers the tentpole contracts chiplessly: seeded-parity property tests
+pinning `JaxGraspEnv` BIT-IDENTICAL to the numpy semantics oracle
+(`VectorGraspEnv`) over matched seed streams — observations, targets,
+outcomes, episode bookkeeping, >= 3 auto-reset boundaries, and the
+truncation-bootstrap boundary from the r08 tests — plus the device
+rasterizer's exact-match corpus; the factored CEM score's equivalence
+to the tiled serving contract; the device ring's extend running inside
+a jitted scan with donated state (no recompile, no silent copy); the
+AnakinLoop's one-executable ledger, in-program min-fill gating, and
+determinism; and the CLI-subprocess smoke for `run_qtopt_replay
+--anakin`: >= 30% eval TD reduction end-to-end through the fused loop
+plus the anakin-throughput block (fused vs numpy-fleet env steps/s at
+the same env count and policy, host-blocked fraction ~0).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensor2robot_tpu.replay.device_buffer import DeviceReplayBuffer
+from tensor2robot_tpu.replay.loop import transition_spec
+from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+from tensor2robot_tpu.research.qtopt import jax_grasping as jg
+from tensor2robot_tpu.research.qtopt.synthetic_grasping import (
+    GraspRetryEnv, VectorGraspEnv)
+
+IMG = 12  # tiny scenes for the structural tests
+
+
+def _seed_stream(base):
+  """CollectorWorker._scene_seed as a closure (the oracle's stream)."""
+  counter = [0]
+
+  def seed_fn():
+    seed = base * 1_000_003 + counter[0]
+    counter[0] += 1
+    return seed
+
+  return seed_fn
+
+
+class TestJaxGraspEnvParity:
+  """ISSUE 6 satellite: the JAX env vs the numpy semantics oracle."""
+
+  @pytest.mark.parametrize("seed", [0, 3])
+  def test_lockstep_bit_identical_to_vector_env(self, seed):
+    """The tentpole property: with the bank built from the oracle's
+    seed stream and the same action sequence, EVERY observable of the
+    JAX env — images and targets at every step, rewards/dones/
+    truncations, auto-reset boundaries, episode/success counts —
+    matches the numpy `VectorGraspEnv` bit for bit."""
+    n, max_attempts = 4, 3
+    bank = jg.make_scene_bank(96, image_size=IMG, base_seed=seed)
+    env = jg.JaxGraspEnv(n, image_size=IMG, max_attempts=max_attempts,
+                         radius=0.4, bank=bank)
+    state = env.init_state(jax.random.key(0))
+    step = jax.jit(env.step_fn())
+    venv = VectorGraspEnv(n, image_size=IMG, max_attempts=max_attempts,
+                          radius=0.4)
+    seeds = _seed_stream(seed)
+    venv.reset([seeds() for _ in range(n)])
+    rng = np.random.default_rng(seed + 100)
+    boundaries = 0
+    for t in range(20):
+      np.testing.assert_array_equal(np.asarray(state.images),
+                                    venv.images)
+      np.testing.assert_array_equal(np.asarray(state.targets),
+                                    venv.targets)
+      actions = rng.uniform(-1, 1, (n, 4)).astype(np.float32)
+      o_rewards, o_dones, o_trunc = venv.step(actions, seed_fn=seeds)
+      state, (rewards, dones, trunc) = step(state, jnp.asarray(actions),
+                                            jax.random.key(t))
+      np.testing.assert_array_equal(np.asarray(rewards), o_rewards)
+      np.testing.assert_array_equal(np.asarray(dones), o_dones)
+      np.testing.assert_array_equal(np.asarray(trunc), o_trunc)
+      boundaries += int((o_dones > 0).sum() + o_trunc.sum())
+    assert int(state.episodes) == venv.episodes
+    assert int(state.successes) == venv.successes
+    assert boundaries >= 3  # the property actually crossed resets
+
+  def test_truncation_bootstrap_boundary_transitions(self):
+    """The r08 boundary case through the FUSED transition recipe: a
+    success mid-budget (done=1, reset), a full failed budget
+    (truncation: done=0, bootstraps, reset), then a fresh-scene
+    success — transitions bit-identical to the vector actor's."""
+    plan = (False, True, False, False, False, True)
+    max_attempts = 3
+
+    def hit_action(target, hit):
+      action = np.full((1, 4), 0.9, np.float32)
+      action[0, :2] = (target if hit
+                       else np.where(target >= 0, -0.95, 0.95))
+      return action
+
+    # JAX env, the anakin recipe: obs snapshot, next_image == obs.
+    bank = jg.make_scene_bank(64, image_size=IMG, base_seed=5)
+    env = jg.JaxGraspEnv(1, image_size=IMG, max_attempts=max_attempts,
+                         radius=0.4, bank=bank)
+    state = env.init_state(jax.random.key(0))
+    step = jax.jit(env.step_fn())
+    jax_rows = []
+    scene_ids = []
+    for t, hit in enumerate(plan):
+      obs = np.asarray(state.images)
+      action = hit_action(np.asarray(state.targets)[0], hit)
+      state, (rewards, dones, trunc) = step(state, jnp.asarray(action),
+                                            jax.random.key(t))
+      scene_ids.append(obs.tobytes())
+      jax_rows.append((obs, action, np.asarray(rewards),
+                       np.asarray(dones), np.asarray(trunc)))
+
+    # Oracle env through the identical plan.
+    seeds = _seed_stream(5)
+    venv = VectorGraspEnv(1, image_size=IMG, max_attempts=max_attempts,
+                          radius=0.4)
+    venv.reset([seeds()])
+    for (obs, action, rewards, dones, trunc) in jax_rows:
+      np.testing.assert_array_equal(obs, venv.images)
+      o_rewards, o_dones, o_trunc = venv.step(action, seed_fn=seeds)
+      np.testing.assert_array_equal(rewards, o_rewards)
+      np.testing.assert_array_equal(dones, o_dones)
+      np.testing.assert_array_equal(trunc, o_trunc)
+    dones = np.concatenate([row[3] for row in jax_rows])
+    truncs = np.concatenate([row[4] for row in jax_rows])
+    np.testing.assert_array_equal(dones, [0., 1., 0., 0., 0., 1.])
+    # Truncation flags ONLY the failed budget exhaustion (step 4).
+    np.testing.assert_array_equal(truncs.astype(np.float32),
+                                  [0., 0., 0., 0., 1., 0.])
+    # Resets actually happened: scene changes exactly after the
+    # success (step 1) and after the truncation (step 4).
+    changes = [scene_ids[i] != scene_ids[i + 1]
+               for i in range(len(scene_ids) - 1)]
+    assert changes == [False, True, False, False, True]
+
+  def test_bank_rows_match_scalar_resets(self):
+    """Bank row j is bit-identical to GraspRetryEnv.reset(seed_j) for
+    the stream's j-th seed (the scene-assignment parity anchor)."""
+    bank = jg.make_scene_bank(6, image_size=IMG, base_seed=7)
+    seeds = _seed_stream(7)
+    env = GraspRetryEnv(image_size=IMG, max_attempts=3, radius=0.4)
+    for j in range(6):
+      env.reset(seeds())
+      np.testing.assert_array_equal(np.asarray(bank.images[j]),
+                                    env.image)
+      np.testing.assert_array_equal(np.asarray(bank.targets[j]),
+                                    env.target)
+
+  def test_device_rasterizer_bit_exact_on_oracle_corpus(self):
+    """`render_scenes` (the procedural mode's observation source)
+    reproduces the oracle renderer's uint8 images EXACTLY on a
+    128-scene corpus — the compensated-arithmetic disc decision vs
+    pose_env's float64 rasterization."""
+    bank = jg.make_scene_bank(128, image_size=IMG, base_seed=11)
+    env = jg.JaxGraspEnv(4, image_size=IMG, bank=None)
+    rendered = np.asarray(jax.jit(env.render_scenes)(bank.targets))
+    np.testing.assert_array_equal(rendered, np.asarray(bank.images))
+
+  def test_procedural_mode_runs_without_bank(self):
+    """Per-env PRNG resets + on-device rendering (the domain-
+    randomization substrate): distinct scenes, deterministic in key."""
+    env = jg.JaxGraspEnv(4, image_size=IMG, max_attempts=2, radius=0.4)
+    state = env.init_state(jax.random.key(1))
+    assert not np.array_equal(np.asarray(state.images[0]),
+                              np.asarray(state.images[1]))
+    state2 = env.init_state(jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(state.images),
+                                  np.asarray(state2.images))
+    step = jax.jit(env.step_fn())
+    # Force terminals (hit every target): resets draw FRESH scenes.
+    actions = np.zeros((4, 4), np.float32)
+    actions[:, :2] = np.asarray(state.targets)
+    before = np.asarray(state.images).copy()
+    state, (rewards, _, _) = step(state, jnp.asarray(actions),
+                                  jax.random.key(9))
+    assert np.all(np.asarray(rewards) == 1.0)
+    assert not np.array_equal(np.asarray(state.images), before)
+    assert int(state.episodes) == 4 and int(state.successes) == 4
+
+
+class TestFactoredScore:
+  """The factored CEM contract: identical Q, image tower hoisted."""
+
+  def _model(self):
+    return TinyQCriticModel(image_size=IMG,
+                            optimizer_fn=lambda: optax.adam(1e-3))
+
+  def test_factored_composes_to_predict_fn(self):
+    model = self._model()
+    variables = jax.device_get(
+        model.init_variables(jax.random.key(0), batch_size=2))
+    rng = np.random.default_rng(2)
+    features = {
+        "image": rng.integers(0, 255, (6, IMG, IMG, 3), np.uint8),
+        "action": rng.uniform(-1, 1, (6, 4)).astype(np.float32),
+    }
+    encode_fn, q_from_code_fn = model.factored_cem_fns()
+    code = encode_fn(variables, {"image": features["image"]})
+    split = q_from_code_fn(variables, {"image": code,
+                                       "action": features["action"]})
+    whole = model.predict_fn(variables, features)
+    np.testing.assert_allclose(np.asarray(split["q_predicted"]),
+                               np.asarray(whole["q_predicted"]),
+                               rtol=1e-6)
+
+  def test_factored_bellman_targets_match_tiled(self):
+    """make_bellman_targets_fn(factored=True) computes the SAME
+    targets as the tiled serving-score recipe — the score contract
+    holds with the image tower hoisted out of the sample loop."""
+    from tensor2robot_tpu.replay.bellman import make_bellman_targets_fn
+    model = self._model()
+    variables = jax.device_get(
+        model.init_variables(jax.random.key(0), batch_size=2))
+    rng = np.random.default_rng(3)
+    next_images = jnp.asarray(
+        rng.integers(0, 255, (6, IMG, IMG, 3), np.uint8))
+    rewards = jnp.asarray(rng.random(6, np.float32))
+    dones = jnp.asarray((rng.random(6) < 0.5).astype(np.float32))
+    keys = jax.random.split(jax.random.key(4), 6)
+    kwargs = dict(action_size=4, gamma=0.8, num_samples=8,
+                  num_elites=2, iterations=2, clip_targets=True)
+    tiled, _ = jax.jit(make_bellman_targets_fn(model, **kwargs))(
+        variables, next_images, rewards, dones, keys)
+    factored, _ = jax.jit(
+        make_bellman_targets_fn(model, factored=True, **kwargs))(
+            variables, next_images, rewards, dones, keys)
+    np.testing.assert_allclose(np.asarray(factored), np.asarray(tiled),
+                               atol=1e-6)
+
+  def test_unfactored_model_falls_back(self):
+    """Models without a factored form return None (generic tiled path
+    stays the contract) and factored=True refuses loudly."""
+    from tensor2robot_tpu.replay.bellman import make_bellman_targets_fn
+    from tensor2robot_tpu.research.qtopt.t2r_models import (
+        QTOptGraspingModel)
+    model = QTOptGraspingModel(image_size=16)
+    assert model.factored_cem_fns() is None
+    with pytest.raises(ValueError, match="no factored CEM form"):
+      make_bellman_targets_fn(model, 4, 0.9, 8, 2, 2, True,
+                              factored=True)
+
+
+class TestExtendInsideJittedScan:
+  """ISSUE 6 satellite: DeviceReplayBuffer.extend inside a jitted scan
+  with donated state — no recompile, no silent copy."""
+
+  def _buffer(self, capacity=32, chunk=4):
+    return DeviceReplayBuffer(
+        transition_spec(IMG, 4), capacity=capacity, sample_batch_size=8,
+        seed=0, prioritized=True, ingest_chunk=chunk)
+
+  def _chunks(self, steps, chunk, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "image": rng.integers(0, 255, (steps, chunk, IMG, IMG, 3),
+                              np.uint8),
+        "action": rng.uniform(-1, 1, (steps, chunk, 4)).astype(
+            np.float32),
+        "reward": rng.random((steps, chunk), dtype=np.float32),
+        "done": (rng.random((steps, chunk)) < 0.5).astype(np.float32),
+        "next_image": rng.integers(0, 255, (steps, chunk, IMG, IMG, 3),
+                                   np.uint8),
+    }
+
+  def test_scan_extend_donates_and_matches_host_path(self):
+    steps, chunk = 6, 4
+    buf = self._buffer(chunk=chunk)
+    extend = buf.extend_fn()
+
+    def scan_extend(state, stacked):
+      return jax.lax.scan(
+          lambda s, batch: (extend(s, batch), None), state, stacked)[0]
+
+    stacked = {k: jnp.asarray(v) for k, v in
+               self._chunks(steps, chunk).items()}
+    # ONE AOT executable (the repo's ledger idiom) with the state
+    # donated — the megastep/anakin compilation shape.
+    exec_ = jax.jit(scan_extend, donate_argnums=(0,)).lower(
+        buf.state, stacked).compile()
+    state_in = buf.state
+    in_buffers = jax.tree_util.tree_leaves(state_in.storage)
+    state_out = exec_(state_in, stacked)
+    # Donation actually happened: the input storage buffers are DEAD
+    # (updated in place), not silently copied into fresh allocations.
+    assert all(buffer.is_deleted() for buffer in in_buffers)
+    # No recompile channel exists: AOT rejects shape drift outright.
+    with pytest.raises(Exception):
+      exec_(state_out, {k: v[:, :2] for k, v in stacked.items()})
+
+    # Contents: bit-identical to the host-facing chunked extend path.
+    host = self._buffer(chunk=chunk)
+    chunks = self._chunks(steps, chunk)
+    for t in range(steps):
+      host.extend({k: v[t] for k, v in chunks.items()})
+    assert host.compile_counts["device_extend"] == 1
+    for key in state_out.storage:
+      np.testing.assert_array_equal(
+          np.asarray(state_out.storage[key]),
+          np.asarray(host.state.storage[key]), err_msg=key)
+    assert int(state_out.append_count) == steps * chunk
+    np.testing.assert_array_equal(np.asarray(state_out.tree),
+                                  np.asarray(host.state.tree))
+
+
+class _AnakinSetup:
+
+  def build(self, num_envs=4, inner_steps=8, train_every=2,
+            min_fill=0, seed=0, factored=True):
+    from tensor2robot_tpu.export import export_utils
+    from tensor2robot_tpu.replay.anakin import AnakinLoop
+    from tensor2robot_tpu.train.trainer import Trainer
+    model = TinyQCriticModel(image_size=IMG,
+                             optimizer_fn=lambda: optax.adam(1e-3))
+    if not factored:
+      model.factored_cem_fns = lambda: None  # generic tiled path
+    trainer = Trainer(model, seed=seed)
+    state = trainer.create_train_state(batch_size=8)
+    variables = export_utils.fetch_variables_to_host(
+        state.variables(use_ema=True))
+    buf = DeviceReplayBuffer(
+        transition_spec(IMG, 4), capacity=64, sample_batch_size=8,
+        seed=seed, prioritized=True, ingest_chunk=num_envs,
+        mesh=trainer.mesh)
+    bank = jg.make_scene_bank(64, image_size=IMG, base_seed=seed)
+    env = jg.JaxGraspEnv(num_envs, image_size=IMG, max_attempts=3,
+                         radius=0.4, bank=bank)
+    loop = AnakinLoop(model, trainer, buf, env, action_size=4,
+                      gamma=0.8, num_samples=4, num_elites=2,
+                      iterations=2, inner_steps=inner_steps,
+                      train_every=train_every, min_fill=min_fill,
+                      seed=seed + 13)
+    loop.refresh(variables, step=0)
+    return state, loop, buf, variables
+
+
+class TestAnakinLoop(_AnakinSetup):
+
+  def test_one_executable_min_fill_gate_and_counters(self):
+    # min_fill = 40: dispatch 1 collects 4 * 8 = 32 < 40 -> the
+    # in-program lax.cond gate must hold ALL training back; dispatch 2
+    # crosses the fill mid-scan and trains the gated remainder.
+    state, loop, buf, variables = self.build(min_fill=40)
+    state, metrics = loop.step(state)
+    assert metrics["trained_steps"] == 0
+    assert int(jax.device_get(state.step)) == 0
+    assert buf.size == 32
+    state, metrics = loop.step(state)
+    assert metrics["trained_steps"] > 0
+    assert loop.trained_steps == metrics["trained_steps"]
+    assert int(jax.device_get(state.step)) == loop.trained_steps
+    # Target refresh swaps arrays, never recompiles (megastep parity).
+    bumped = jax.tree_util.tree_map(lambda x: x + 0.05, variables)
+    loop.refresh(bumped, step=8)
+    state, metrics = loop.step(state)
+    assert loop.compile_counts == {"anakin_step": 1}
+    assert buf.compile_counts == {}  # extend lives INSIDE the program
+    assert loop.env_steps == 3 * 8 * 4
+    assert loop.episodes > 0
+    for value in metrics.values():
+      assert np.isfinite(value)
+
+  def test_deterministic_across_rebuilds(self):
+    def metrics_stream(seed):
+      state, loop, _, _ = self.build(seed=seed, min_fill=8)
+      out = []
+      for _ in range(2):
+        state, metrics = loop.step(state)
+        out.append(metrics)
+      return out
+
+    a, b = metrics_stream(0), metrics_stream(0)
+    assert a == b
+    assert metrics_stream(1) != a
+
+  def test_tiled_fallback_compiles_and_trains(self):
+    """A model with no factored form runs the generic serving-score
+    path inside the same fused program."""
+    state, loop, _, _ = self.build(factored=False, min_fill=8)
+    state, metrics = loop.step(state)
+    assert metrics["trained_steps"] > 0
+    assert loop.compile_counts == {"anakin_step": 1}
+
+  def test_validates_chunk_and_cadence(self):
+    from tensor2robot_tpu.replay.anakin import AnakinLoop
+    state, loop, buf, _ = self.build()
+    env = loop._env
+    with pytest.raises(ValueError, match="ingest_chunk"):
+      AnakinLoop(loop._model, loop._trainer,
+                 DeviceReplayBuffer(transition_spec(IMG, 4), 64, 8,
+                                    ingest_chunk=8),
+                 env, inner_steps=8, train_every=2)
+    with pytest.raises(ValueError, match="multiple"):
+      AnakinLoop(loop._model, loop._trainer, buf, env,
+                 inner_steps=8, train_every=3)
+
+
+@pytest.fixture(scope="module")
+def anakin_smoke_results(tmp_path_factory):
+  """ONE anakin smoke shared by the acceptance assertions — the CLI in
+  a subprocess under the ARTIFACT environment (plain single-device CPU
+  backend; same rationale as the device-resident and vector-actor
+  smoke fixtures: the harness's 8-virtual-device mesh measures
+  virtualization, not fusion). Protocol = REPLAY_SMOKE_r09.json's."""
+  import subprocess
+  import sys
+  tmp = tmp_path_factory.mktemp("anakin_smoke")
+  logdir = str(tmp / "logs")
+  out = tmp / "smoke.json"
+  env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+  env["JAX_PLATFORMS"] = "cpu"
+  root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+  res = subprocess.run(
+      [sys.executable, "-m", "tensor2robot_tpu.bin.run_qtopt_replay",
+       "--smoke", "--anakin", "--steps", "300",
+       "--logdir", logdir, "--out", str(out)],
+      capture_output=True, text=True, timeout=480, env=env, cwd=root)
+  assert res.returncode == 0, res.stderr[-2000:]
+  lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+  assert len(lines) == 1, res.stdout  # the ONE-JSON-line contract
+  results = json.loads(lines[0])
+  assert json.loads(out.read_text()) == results
+  return results, logdir
+
+
+class TestAnakinSmokeCLI:
+  """ISSUE 6 acceptance: the fused loop holds the >= 30% eval TD bar
+  end to end, the ledger shows exactly ONE anakin_step executable, and
+  the anakin-throughput block reports the fused-vs-numpy-fleet env
+  rate at the same env count and policy with host-blocked ~0."""
+
+  def test_td_reduction_through_fused_loop(self, anakin_smoke_results):
+    results, _ = anakin_smoke_results
+    assert results["anakin"] is True
+    assert results["device_resident"] is True
+    assert results["eval_td_reduction"] >= 0.30, results["eval_history"]
+
+  def test_ledger_exactly_one_anakin_executable(self,
+                                                anakin_smoke_results):
+    results, _ = anakin_smoke_results
+    ledger = results["compile_counts"]
+    assert ledger["anakin_step"] == 1
+    # The fused program subsumes every hot-path executable: no megastep,
+    # no host train step, no acting bucket, no host-fed extend.
+    for absent in ("megastep", "train_step", "device_extend"):
+      assert absent not in ledger, ledger
+    assert not any(key.startswith("cem_bucket_") for key in ledger)
+    assert all(value == 1 for value in ledger.values()), ledger
+
+  def test_loop_collected_on_device(self, anakin_smoke_results):
+    results, _ = anakin_smoke_results
+    assert results["steps"] >= 300
+    assert results["env_steps_collected"] > 0
+    assert results["episodes_collected"] > 50
+    assert 0 < results["collector_success_rate"] <= 1
+    # No queue, no feeder: the host never touched a transition.
+    stats = results["queue"]
+    assert stats["enqueued"] == 0 and stats["dequeued"] == 0
+    assert results["param_refreshes"] >= 10
+
+  def test_anakin_throughput_block(self, anakin_smoke_results):
+    """Block structure always; the >= 5x acceptance bar itself lives
+    in the committed artifact (quiet-run medians) and is asserted at
+    full strength only on >= 4-core hosts — on the 2-core CI box the
+    floors below stay far above the noise floor (measured ~10x) while
+    staying out of the flaky-under-contention class (the ROADMAP
+    maintenance rule the r09 de-flake satellite applies repo-wide)."""
+    results, _ = anakin_smoke_results
+    block = results["anakin_throughput"]
+    assert block["dtype"] == "float32"
+    assert block["anakin"]["dtype"] == "float32"
+    for path, field in (
+        ("vector_fleet", "env_steps_per_sec"),
+        ("vector_fleet", "collect_only_env_steps_per_sec"),
+        ("vector_fleet", "learner_steps_per_sec"),
+        ("anakin", "env_steps_per_sec"),
+        ("anakin", "train_steps_per_sec"),
+        ("anakin", "host_blocked_fraction"),
+    ):
+      assert set(block[path][field]) == {"median", "min", "max",
+                                         "trials"}, (path, field)
+    # The zero-host-work claim, honestly measured: blocked = wall time
+    # outside AnakinLoop's own in-executable clock, so step()'s host
+    # bookkeeping COUNTS against the bar. Sub-millisecond bookkeeping
+    # vs ~0.1-0.3s dispatches keeps 5% far from the noise floor even
+    # on the 2-core box.
+    assert block["anakin"]["host_blocked_fraction"]["median"] <= 0.05
+    counts = block["compile_counts"]
+    assert counts["anakin_step"] == 1
+    assert sum(1 for key in counts
+               if key.startswith("vector_cem_bucket_")) == 1
+    assert all(value == 1 for value in counts.values()), counts
+    if (os.cpu_count() or 1) >= 4:
+      assert block["speedup"]["median"] >= 5.0, block["speedup"]
+    else:
+      assert block["speedup"]["max"] >= 3.0, block["speedup"]
+      assert block["speedup"]["median"] >= 2.0, block["speedup"]
+
+  def test_metrics_flow_through_metric_writer(self, anakin_smoke_results):
+    _, logdir = anakin_smoke_results
+    path = os.path.join(logdir, "metrics.jsonl")
+    assert os.path.exists(path)
+    seen = set()
+    with open(path) as f:
+      for line in f:
+        seen.update(json.loads(line).keys())
+    for key in ("replay/fill_fraction", "replay/sample_staleness",
+                "replay/target_lag", "replay/eval_td_error",
+                "replay/train_loss", "replay/env_steps"):
+      assert key in seen, (key, sorted(seen))

@@ -309,10 +309,14 @@ class TestDeviceResidentSmoke:
     """>= 2x train-steps/s over the host path at the same batch shape.
 
     The committed artifact (REPLAY_SMOKE_r07.json) carries the quiet-
-    run medians; under CI contention timing asserts flake (the serving
-    smoke's known failure mode), so here the bar is the best trial
-    with a floor on the median — the fused program either amortizes
-    dispatch or it doesn't, and contention only suppresses the ratio.
+    run medians; the speedup bars themselves are GATED on
+    os.cpu_count() >= 4 (ISSUE 6 de-flake satellite, per the ROADMAP
+    maintenance note): on a 2-core box the 2x bar sits at the
+    contention noise floor and failed ~50% at a clean HEAD — verified
+    diff-independent — so below 4 cores this asserts the block's
+    structure and the structural (non-timing) host-blocked claim only,
+    and the quantitative bar is carried by the committed artifact's
+    quiet-run medians.
     """
     results, _ = device_smoke_results
     block = results["learner_throughput"]
@@ -322,10 +326,11 @@ class TestDeviceResidentSmoke:
                     "host_blocked_fraction"):
         spread = block[path][field]
         assert set(spread) == {"median", "min", "max", "trials"}
-    assert block["speedup"]["max"] >= 2.0, block["speedup"]
-    assert block["speedup"]["median"] >= 1.5, block["speedup"]
+    if (os.cpu_count() or 1) >= 4:
+      assert block["speedup"]["max"] >= 2.0, block["speedup"]
+      assert block["speedup"]["median"] >= 1.5, block["speedup"]
     # The design claim, measured: the megastep host-blocked fraction
-    # collapses vs the host path's.
+    # collapses vs the host path's (structural, not a timing race).
     assert (block["device_megastep"]["host_blocked_fraction"]["median"]
             <= 0.05)
     assert block["compile_counts"]["megastep"] == 1

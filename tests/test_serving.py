@@ -478,9 +478,19 @@ class TestFleetSmokeCLI:
     # Batching amortization: 16 concurrent closed-loop clients clear
     # >= 3x the single-client closed-loop rate (acceptance bar; the
     # tiny smoke model makes per-flush dispatch, not conv math, the
-    # dominant cost — the regime batching amortizes). Medians over 3
-    # in-process trials already damp contention; one full re-run is
-    # allowed before declaring the property broken on a shared CI box.
+    # dominant cost — the regime batching amortizes). The bar is GATED
+    # on os.cpu_count() >= 4 (ISSUE 6 de-flake satellite, per the
+    # ROADMAP maintenance note): on a 2-core box the 16 client threads
+    # plus the server fight for two cores and the ratio sits at the
+    # noise floor — verified flaky at a clean HEAD — so below 4 cores
+    # the structural contract above (schema, one-executable-per-bucket
+    # ledger, sane latencies) is the tier-1 claim and the quantitative
+    # bar is carried by the committed SERVING artifact's quiet run.
+    if (os.cpu_count() or 1) < 4:
+      return
+    # Medians over 3 in-process trials already damp contention; one
+    # full re-run is allowed before declaring the property broken on a
+    # shared CI box.
     ratio = amortization(obj)
     if ratio < 3.0:
       retry = self._run_smoke()
