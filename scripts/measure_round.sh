@@ -21,14 +21,23 @@
 #
 # Stages (artifact -> producer):
 #   REPLAY_SMOKE_r0N.json        bin/run_qtopt_replay --smoke --anakin
-#                                (CHIPLESS backstop, runs before any
-#                                chip appears; normally builder-
-#                                committed and skipped — ISSUE 4/5/6.
-#                                This IS the anakin-bench stage too:
-#                                the artifact's anakin_throughput block
+#                                --mesh 8,1 (CHIPLESS backstop, runs
+#                                before any chip appears; normally
+#                                builder-committed and skipped — ISSUE
+#                                4/5/6/7. Since r10 the smoke runs the
+#                                SHARDED protocol: the fused loop over
+#                                an 8-virtual-device dp mesh with
+#                                ZeRO-1, mesh_shape/zero1 in the
+#                                artifact. This IS the anakin-bench
+#                                stage too: the anakin_throughput block
 #                                carries the fused-vs-numpy-fleet env
-#                                rate, the host-blocked fraction, and
-#                                the CEM dtype field)
+#                                rate, host-blocked fraction, and CEM
+#                                dtype)
+#   MULTICHIP_r06.json           replay/anakin_multichip_bench --smoke
+#                                (CHIPLESS backstop too — ISSUE 7: the
+#                                fused executable at 1/2/4/8 virtual
+#                                devices, fixed global workload;
+#                                virtual_mesh caveat inside)
 #   BENCH_DETAIL_r0N.json        bench.py (orchestrator; also emits the
 #                                compact line, saved to BENCH_builder_r0N.json)
 #   SERVING_r0N.json             bin/bench_serving single-robot + --fleet lines
@@ -112,7 +121,22 @@ else
   done
   run_stage "REPLAY_SMOKE_${RTAG}.json" 1800 sh -c '
     python -m tensor2robot_tpu.bin.run_qtopt_replay --smoke \
-      --anakin --out "$STAGE_TMP"'
+      --anakin --mesh 8,1 --out "$STAGE_TMP"'
+fi
+# Second chipless backstop (ISSUE 7): the pod-scale scaling ladder on
+# the 8-virtual-device CPU mesh. Same tmp→mv atomicity and pytest
+# deferral rules as the replay smoke (it is a timing measurement).
+if [ -s "MULTICHIP_r06.json" ]; then
+  log "skip MULTICHIP_r06.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring multichip backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "MULTICHIP_r06.json" 1800 sh -c '
+    python -m tensor2robot_tpu.replay.anakin_multichip_bench --smoke \
+      --out "$STAGE_TMP"'
 fi
 while [ "$(date +%s)" -lt "$deadline" ]; do
   # Never perturb a live test run: the probe's jax import is real CPU

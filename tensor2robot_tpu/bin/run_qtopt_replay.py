@@ -45,6 +45,18 @@ host-blocked fraction and the CEM scoring `dtype`; skip with
 `--no-anakin-bench`). The vector-actor and threaded paths stay the
 measured fallbacks.
 
+`--mesh DP[,TP]` (ISSUE 7) runs the loop over an explicit dp×tp device
+mesh instead of the single-process default. With `--anakin` this is
+the pod-scale configuration: per-shard env fleets, the replay ring
+capacity-sharded per device, the fused learn body data-parallel with
+gradient all-reduce, and ZeRO-1 weight-update sharding applied inside
+the scan — still exactly ONE `anakin_step` executable. In `--smoke`
+mode a DP*TP > 1 mesh bootstraps DP*TP virtual CPU devices by
+re-exec'ing with the canonical CPU-mesh environment (the
+tests/conftest.py idiom); on a chip it meshes the first DP*TP real
+devices. The r10 smoke protocol is `--smoke --anakin --mesh 8,1`; the
+single-device `--anakin` run stays the unchanged semantics oracle.
+
 Prints ONE JSON line (the repo's bench/driver contract): initial/final
 eval Bellman residual, the reduction fraction, replay health counters,
 and `compile_counts` (every value must be 1 — fixed-shape sampling
@@ -63,15 +75,50 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import tempfile
 
 
+def parse_mesh(spec: str):
+  """'8' or '4,2' -> (dp, tp). '0' keeps the mode default mesh."""
+  parts = spec.split(",")
+  if len(parts) > 2:
+    raise ValueError(f"--mesh takes DP or DP,TP, got {spec!r}")
+  try:
+    dp = int(parts[0])
+    tp = int(parts[1]) if len(parts) == 2 else 1
+  except ValueError:
+    raise ValueError(f"--mesh takes integers, got {spec!r}")
+  if dp < 0 or tp < 1:
+    raise ValueError(
+        f"--mesh takes DP >= 1 (or 0 for the mode default) and "
+        f"TP >= 1, got {spec!r}")
+  if dp == 0 and tp != 1:
+    # dp=0 keeps the mode-default mesh, which would silently discard
+    # the requested TP degree — refuse instead.
+    raise ValueError(
+        f"--mesh 0,{tp} mixes the keep-default sentinel with an "
+        "explicit TP degree; name DP explicitly (e.g. "
+        f"--mesh 1,{tp}).")
+  return dp, tp
+
+
 def build_config(smoke: bool, seed: int, device_resident: bool = False,
-                 vector_actors: bool = False, anakin: bool = False):
+                 vector_actors: bool = False, anakin: bool = False,
+                 mesh=(0, 1)):
   from tensor2robot_tpu.replay.loop import ReplayLoopConfig
+  dp, tp = mesh
   if smoke:
+    # The sharded smoke keeps the r09 scale but rounds the env fleet,
+    # sample batch, and ring capacity up to multiples of the data axis
+    # (all three must shard over it, and the smoke CLI exposes no knob
+    # to fix them by hand); power-of-two dp <= 8 keeps the exact
+    # 4-env / batch-32 / capacity-512 oracle shapes.
+    up = lambda v: -(-v // dp) * dp if (anakin and dp > 1) else v
     return ReplayLoopConfig(seed=seed, device_resident=device_resident,
-                            vector_actors=vector_actors, anakin=anakin)
+                            vector_actors=vector_actors, anakin=anakin,
+                            envs_per_collector=up(4), batch_size=up(32),
+                            capacity=up(512), mesh_dp=dp, mesh_tp=tp)
   return ReplayLoopConfig(
       image_size=64, batch_size=32, capacity=50_000, min_fill=2_000,
       num_buffer_shards=4, num_collectors=4, envs_per_collector=8,
@@ -80,16 +127,17 @@ def build_config(smoke: bool, seed: int, device_resident: bool = False,
       eval_batches=8, log_every=50, learning_rate=1e-4, seed=seed,
       device_resident=device_resident, megastep_inner=50,
       ingest_chunk=256, vector_actors=vector_actors, anakin=anakin,
-      anakin_inner=200, anakin_bank_scenes=4096)
+      anakin_inner=200, anakin_bank_scenes=4096, mesh_dp=dp, mesh_tp=tp)
 
 
 def run(steps: int, smoke: bool, logdir: str, seed: int,
         device_resident: bool = False, learner_bench: bool = True,
         vector_actors: bool = False, actor_bench: bool = True,
-        anakin: bool = False, anakin_bench: bool = True) -> dict:
+        anakin: bool = False, anakin_bench: bool = True,
+        mesh=(0, 1)) -> dict:
   from tensor2robot_tpu.replay.loop import ReplayTrainLoop
   config = build_config(smoke, seed, device_resident, vector_actors,
-                        anakin)
+                        anakin, mesh=mesh)
   model = None  # default: the flagship QTOptGraspingModel
   if smoke:
     # CI-scale critic (replay/smoke.py): the flagship's conv tower
@@ -187,13 +235,41 @@ def main(argv=None) -> None:
   parser.add_argument("--no-anakin-bench", action="store_true",
                       help="skip the anakin_throughput comparison "
                            "block on --anakin runs")
+  parser.add_argument("--mesh", default="0",
+                      help="DP or DP,TP device mesh for the loop "
+                           "(default: the mode's single-mesh default; "
+                           "with --anakin this is the pod-scale "
+                           "sharded configuration — ISSUE 7)")
   parser.add_argument("--logdir", default=None,
                       help="metric_writer logdir (default: a tempdir)")
   parser.add_argument("--seed", type=int, default=0)
   parser.add_argument("--out", default=None,
                       help="also write the JSON line to this file")
   args = parser.parse_args(argv)
+  mesh = parse_mesh(args.mesh)
   if args.smoke:
+    n_devices = mesh[0] * mesh[1]
+    if n_devices > 1:
+      # A multi-device smoke needs the virtual CPU mesh configured
+      # BEFORE JAX initializes (and the axon plugin var cleared — it
+      # overrides platform selection in-process): re-exec with the
+      # canonical environment, the tests/conftest.py idiom.
+      # is_cpu_mesh_env is the loop guard: the re-exec'd process
+      # passes it and falls through.
+      from tensor2robot_tpu.utils.cpu_mesh_env import (cpu_mesh_env,
+                                                       is_cpu_mesh_env)
+      if not is_cpu_mesh_env(n_devices):
+        if argv is not None:
+          raise RuntimeError(
+              "a multi-device --smoke mesh needs the virtual CPU mesh "
+              "set up before JAX initializes; call main() with "
+              "argv=None (the CLI re-execs itself) or pre-set "
+              "cpu_mesh_env in the parent.")
+        os.execve(sys.executable,
+                  [sys.executable, "-m",
+                   "tensor2robot_tpu.bin.run_qtopt_replay",
+                   *sys.argv[1:]],
+                  cpu_mesh_env(n_devices))
     # Chipless lane: pin the CPU backend before JAX initializes
     # (mirrors bench_serving --smoke; imports above are lazy for this).
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -205,7 +281,8 @@ def main(argv=None) -> None:
                 vector_actors=args.vector_actors,
                 actor_bench=not args.no_actor_bench,
                 anakin=args.anakin,
-                anakin_bench=not args.no_anakin_bench)
+                anakin_bench=not args.no_anakin_bench,
+                mesh=mesh)
   line = json.dumps(results)
   if args.out:
     with open(args.out, "w") as f:

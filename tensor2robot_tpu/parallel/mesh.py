@@ -73,6 +73,39 @@ def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
   return NamedSharding(mesh, PartitionSpec(axis))
 
 
+def nearest_multiples(value: int, divisor: int) -> str:
+  """'8 or 16'-style fix suggestion for a size that must divide a mesh
+  axis — ONE phrasing for every divisibility-refusal message (ring
+  capacity, env fleet width, learn batch), so the actionable-error
+  contract cannot drift per call site."""
+  lower = (value // divisor) * divisor
+  return f"{lower} or {lower + divisor}" if lower else f"{divisor}"
+
+
+def env_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+  """Sharding for per-shard env fleets: the fleet-width leading dim of
+  every episode-state leaf (images, targets, attempts) splits over
+  `axis`, so each device steps num_envs / axis_size envs of the fused
+  Anakin loop's fleet in place (Podracer's per-core environment slices,
+  arXiv:2104.06272). Same rule as `batch_sharding` — a fleet IS a batch
+  of envs — but named at the call site so the env-state placement reads
+  as intent and can diverge (e.g. a 2D env grid) without touching batch
+  consumers. Fleet width must divide the axis; `replay/anakin.AnakinLoop`
+  validates and names the fix."""
+  return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def ring_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+  """Sharding for device-resident replay rings: the capacity-leading
+  storage/bookkeeping leaves split over `axis`, so each device holds
+  capacity / axis_size slots of the ring in its own HBM (the
+  weight-update-sharding discipline of arXiv:2004.13336 applied to
+  replay state). Capacity must divide the axis;
+  `replay/device_buffer.DeviceReplayBuffer` enforces this with an
+  actionable error instead of silently replicating."""
+  return NamedSharding(mesh, PartitionSpec(axis))
+
+
 def stacked_batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
   """Sharding for K-stacked batches (loop axis, batch, ...): the leading
   scan axis is replicated, the batch dim behind it splits over `axis`
@@ -108,20 +141,29 @@ def shard_batch(mesh: Mesh, batch: Any, axis: str = "data") -> Any:
   queues.
   """
   axis_size = mesh.shape[axis]
-  leaves = jax.tree_util.tree_leaves(batch)
-  if leaves:
-    global_size = np.shape(leaves[0])[0] * jax.process_count()
+  batched_leaves = [leaf for leaf in jax.tree_util.tree_leaves(batch)
+                    if np.ndim(leaf) >= 1]
+  for leaf in batched_leaves:
+    global_size = np.shape(leaf)[0] * jax.process_count()
     if global_size % axis_size != 0:
       raise ValueError(
           f"Global batch size {global_size} (local "
-          f"{np.shape(leaves[0])[0]} × {jax.process_count()} processes) is "
+          f"{np.shape(leaf)[0]} × {jax.process_count()} processes) is "
           f"not divisible by the {axis!r} mesh axis ({axis_size} devices); "
           "choose a batch size that is a multiple of the data-parallel "
           "degree.")
   sharding = batch_sharding(mesh, axis)
+  replicated = replicated_sharding(mesh)
+
+  def leaf_sharding(leaf):
+    # Scalar leaves (loss masks, step counters riding in a batch pytree)
+    # have no batch dim to split: replicate them instead of erroring.
+    return sharding if np.ndim(leaf) >= 1 else replicated
+
   if jax.process_count() == 1:
-    return jax.device_put(batch, sharding)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, leaf_sharding(x)), batch)
   return jax.tree_util.tree_map(
       lambda x: jax.make_array_from_process_local_data(
-          sharding, np.asarray(x)),
+          leaf_sharding(x), np.asarray(x)),
       batch)

@@ -48,6 +48,26 @@ min-fill gate lives INSIDE the program (buffer size test), so there is
 no host-side warm-up phase either — dispatch 0 already runs the final
 steady-state code path.
 
+Pod scale (ISSUE 7): the SAME single executable is mesh-native. On a
+dp×tp mesh (the trainer's), the env fleet shards over the data axis
+(`parallel.mesh.env_sharding` via `JaxGraspEnv.state_shardings`: each
+device steps num_envs / dp envs in its own HBM — Podracer's per-core
+environment slices), the replay ring capacity-shards per device
+(`DeviceReplayBuffer`'s `ring_sharding`, which REFUSES indivisible
+capacities), the sampled learn batch is pinned back onto the data axis
+so the label→grad→apply chain runs data-parallel with XLA inserting
+the gradient all-reduce against replicated params, and — when the
+Trainer is built with `shard_optimizer_state=True` — the ZeRO-1
+cross-replica weight-update sharding (arXiv:2004.13336) applies INSIDE
+the scanned train body, exactly as in the supervised path. Still ONE
+`anakin_step` in the ledger; the host work is unchanged (zero in the
+steady state). Per-shard PRNG streams need no extra machinery: acting,
+exploration, and label keys are already derived per-env/per-sample via
+`fold_in` over a global index, so each device materializes only its
+slice of the key array — the GLOBAL stream is identical on every mesh
+shape, which is what makes the 1-device run the semantics oracle for
+the sharded one (tests/test_anakin.py pins this).
+
 Determinism: acting, exploration, env-reset, sampling, and label
 randomness are all pure functions of (seed, outer, inner[, position])
 via fold_in — one dispatch stream is replayable and independent of
@@ -62,6 +82,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.replay.bellman import (TargetNetwork,
                                              make_bellman_targets_fn,
                                              make_cem_states_and_score)
@@ -117,7 +138,37 @@ class AnakinLoop(TargetNetwork):
           f"buffer ingest_chunk {buffer.ingest_chunk} must equal the "
           f"env fleet width {env.num_envs}: the fused extend runs at "
           "ONE fixed chunk shape — the fleet's")
-    super().__init__(polyak_tau=polyak_tau)
+    # Mesh-native placement (ISSUE 7): the trainer's mesh is THE mesh —
+    # env fleet and learn batch shard over its data axis, so both must
+    # divide it (an indivisible fleet/batch would silently replicate,
+    # the exact trap the ring sharding refuses).
+    self.mesh = trainer.mesh
+    self._data_axis = trainer.data_axis
+    axis_size = self.mesh.shape[self._data_axis]
+    if env.num_envs % axis_size:
+      raise ValueError(
+          f"env fleet width {env.num_envs} is not divisible by the "
+          f"{self._data_axis!r} mesh axis size ({axis_size} devices), so "
+          f"the per-shard env fleets cannot form. Use a fleet of "
+          f"{mesh_lib.nearest_multiples(env.num_envs, axis_size)} envs, or a "
+          f"data axis that divides {env.num_envs}.")
+    if buffer.sample_batch_size % axis_size:
+      raise ValueError(
+          f"sample batch {buffer.sample_batch_size} is not divisible by "
+          f"the {self._data_axis!r} mesh axis size ({axis_size} devices), "
+          f"so the fused learn body cannot run data-parallel. Use a batch "
+          f"of "
+          f"{mesh_lib.nearest_multiples(buffer.sample_batch_size, axis_size)}.")
+    self._sharded = axis_size > 1
+    # Target variables live replicated ON THE MESH when sharded (the
+    # AOT executable is lowered against this placement; a host-numpy
+    # refresh landing on device 0 only would make every shard read CEM
+    # labels across the mesh). The 1-device mesh keeps the r09 plain
+    # copy — the single-device path is the unchanged semantics oracle.
+    super().__init__(
+        polyak_tau=polyak_tau,
+        sharding=(mesh_lib.replicated_sharding(self.mesh)
+                  if self._sharded else None))
     self._model = model
     self._trainer = trainer
     self._buffer = buffer
@@ -141,7 +192,14 @@ class AnakinLoop(TargetNetwork):
     self.compile_counts: Dict[str, int] = {}
     self._exec = None
     self._outer = 0
-    self._env_state = env.init_state(jax.random.key(seed + 21))
+    # Per-shard env fleets: the fleet-width leaves split over the data
+    # axis at PLACEMENT time, so the executable is lowered (and its
+    # donation paired) against the sharded layout from dispatch 0.
+    self._env_shardings = env.state_shardings(self.mesh, self._data_axis)
+    env_state = env.init_state(jax.random.key(seed + 21))
+    if self._sharded:
+      env_state = jax.device_put(env_state, self._env_shardings)
+    self._env_state = env_state
     # Device counters snapshot (dispatch granularity, no mid-scan D2H).
     self.env_steps = 0
     self.trained_steps = 0
@@ -151,6 +209,12 @@ class AnakinLoop(TargetNetwork):
     self.exec_seconds = 0.0
 
   # --- fleet bookkeeping (ActorFleet-shaped instruments) -------------------
+
+  @property
+  def mesh_shape(self) -> Dict[str, int]:
+    """{axis: size} of the mesh the fused executable spans (the smoke
+    artifact's record of HOW the loop was sharded)."""
+    return dict(self.mesh.shape)
 
   @property
   def episodes(self) -> int:
@@ -173,10 +237,34 @@ class AnakinLoop(TargetNetwork):
         model, self._action_size, self._gamma, self._num_samples,
         self._num_elites, self._iterations, self._clip_targets,
         factored=factored is not None)
+    # Data-parallel pins for the multi-device mesh. All three are None/
+    # identity on the 1-device mesh, so the single-device program — the
+    # semantics oracle and measured fallback — lowers exactly as in r09.
+    if self._sharded:
+      batch_rule = mesh_lib.batch_sharding(self.mesh, self._data_axis)
+      fleet_rule = mesh_lib.env_sharding(self.mesh, self._data_axis)
+      env_shardings = self._env_shardings
+      buffer_shardings = self._buffer.state_shardings()
+      # The sampled gather out of the capacity-sharded ring re-lands
+      # batch-split over the data axis, so label→grad→apply runs
+      # data-parallel (XLA inserts the gradient all-reduce; with the
+      # trainer's shard_optimizer_state the ZeRO-1 update sharding
+      # applies inside this same scanned body).
+      constrain_batch = (
+          lambda batch: jax.lax.with_sharding_constraint(batch, batch_rule))
+      constrain_carry = (
+          lambda e, b: (jax.lax.with_sharding_constraint(e, env_shardings),
+                        jax.lax.with_sharding_constraint(b, buffer_shardings)))
+      constrain_actions = (
+          lambda a: jax.lax.with_sharding_constraint(a, fleet_rule))
+    else:
+      constrain_batch = None
+      constrain_carry = lambda e, b: (e, b)
+      constrain_actions = lambda a: a
     learn = make_learn_iteration_fn(
         model, self._trainer.train_step_fn(), sample, update_priorities,
         targets_fn, getattr(model, "target_key", "target_q"),
-        self._clip_targets)
+        self._clip_targets, constrain_batch=constrain_batch)
     n = self._env.num_envs
     batch_size = self._buffer.sample_batch_size
     k = self.inner_steps
@@ -219,8 +307,11 @@ class AnakinLoop(TargetNetwork):
       scripted = uniform.at[:, :2].set(
           jnp.clip(targets + noise, -1.0, 1.0))
       actions = jnp.where((draw < epsilon)[:, None], uniform, best)
-      return jnp.where((draw >= 1.0 - scripted_fraction)[:, None],
-                       scripted, actions)
+      # In-shard acting: pin the fleet's actions back onto the env
+      # slices (per-env fold_in keys already shard with the arange).
+      return constrain_actions(
+          jnp.where((draw >= 1.0 - scripted_fraction)[:, None],
+                    scripted, actions))
 
     zero_metrics = {
         "loss": jnp.zeros((), jnp.float32),
@@ -267,6 +358,10 @@ class AnakinLoop(TargetNetwork):
 
         train_state, buffer_state, metrics = jax.lax.cond(
             do_train, run_learn, skip_learn, train_state, buffer_state)
+        # Hold the carried env/ring layouts shard-stable through every
+        # scan iteration (and therefore across dispatches: the donated
+        # outputs re-enter at the same shardings the AOT lowering saw).
+        env_state, buffer_state = constrain_carry(env_state, buffer_state)
         # Keep the LAST TRAINED metrics (skipped steps report zeros).
         last_metrics = jax.tree_util.tree_map(
             lambda new, old: jnp.where(do_train, new, old),

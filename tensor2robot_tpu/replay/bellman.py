@@ -114,26 +114,45 @@ class TargetNetwork:
   """Target-net lifecycle shared by the host and device learners:
   hard-lag or polyak refresh (a pure array swap — consumers take the
   target as an executable ARGUMENT, so refresh never recompiles),
-  plus the lag/refresh-count health metrics."""
+  plus the lag/refresh-count health metrics.
 
-  def __init__(self, variables=None, polyak_tau: Optional[float] = None):
+  `sharding` (a NamedSharding, normally the consumer mesh's replicated
+  rule) pins where refresh PLACES the copied target pytree. The fused
+  mesh-native learners need this: their AOT executables are lowered
+  against the target's placement, and a refresh fed from host numpy
+  would otherwise land the arrays on device 0 only — every shard's CEM
+  labeling then reads across the mesh instead of from local HBM, and a
+  later refresh with a different placement would be rejected by the
+  executable outright. With sharding=None (the host BellmanUpdater)
+  refresh keeps today's plain-copy behavior.
+  """
+
+  def __init__(self, variables=None, polyak_tau: Optional[float] = None,
+               sharding=None):
     self._polyak_tau = polyak_tau
+    self._target_sharding = sharding
     self._target_variables = (
         None if variables is None
-        else jax.tree_util.tree_map(jnp.copy, variables))
+        else self._place(jax.tree_util.tree_map(jnp.copy, variables)))
     self._refresh_count = 0
     self.last_refresh_step = 0
+
+  def _place(self, variables):
+    if self._target_sharding is None:
+      return variables
+    return jax.device_put(variables, self._target_sharding)
 
   def refresh(self, variables, step: int) -> None:
     """Pulls the online variables into the target net (lag or polyak;
     the first refresh of a cold target is always a hard copy)."""
     if self._polyak_tau is None or self._target_variables is None:
-      self._target_variables = jax.tree_util.tree_map(jnp.copy, variables)
+      target = jax.tree_util.tree_map(jnp.copy, variables)
     else:
       tau = self._polyak_tau
-      self._target_variables = jax.tree_util.tree_map(
+      target = jax.tree_util.tree_map(
           lambda online, target: tau * online + (1.0 - tau) * target,
           variables, self._target_variables)
+    self._target_variables = self._place(target)
     self._refresh_count += 1
     self.last_refresh_step = int(step)
 

@@ -42,6 +42,7 @@ fold-in discipline the fleet server and host updater hold.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -57,6 +58,8 @@ from tensor2robot_tpu.replay.bellman import (TargetNetwork,
 from tensor2robot_tpu.replay.ring_buffer import (SampleInfo,
                                                  _validate_against_spec)
 from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+_LOG = logging.getLogger(__name__)
 
 
 class DeviceReplayState(flax.struct.PyTreeNode):
@@ -190,7 +193,12 @@ class DeviceReplayBuffer:
       ingest_chunk: int = 64,
       mesh: Optional[jax.sharding.Mesh] = None,
       data_axis: str = "data",
+      shard_capacity: bool = True,
   ):
+    """shard_capacity=False keeps a DELIBERATELY replicated ring on a
+    multi-device mesh (every device holds the full capacity — correct,
+    just memory-expensive). The default shards the capacity axis and
+    REFUSES indivisible capacities instead of silently replicating."""
     if capacity < 1:
       raise ValueError(f"capacity must be >= 1, got {capacity}")
     if sample_batch_size < 1:
@@ -213,13 +221,34 @@ class DeviceReplayBuffer:
     self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
     self._data_axis = data_axis
     self._replicated = mesh_lib.replicated_sharding(self.mesh)
-    # Capacity-axis sharding via the EXISTING mesh rule (the batch rule
-    # applied to the (capacity, ...) leading dim). Indivisible
-    # capacities fall back to replication — correct, just unsharded.
+    # Capacity-axis sharding (mesh_lib.ring_sharding: each device owns
+    # capacity / axis_size slots of the ring in its own HBM). Before
+    # ISSUE 7 an indivisible capacity fell back to replication WITHOUT
+    # A TRACE — a pod-scale run would quietly hold the full ring on
+    # every chip; now it refuses with the nearest divisible capacities.
     axis_size = self.mesh.shape[data_axis]
+    if shard_capacity and axis_size > 1 and capacity % axis_size:
+      raise ValueError(
+          f"capacity {capacity} is not divisible by the {data_axis!r} "
+          f"mesh axis size ({axis_size} devices), so the ring cannot "
+          f"capacity-shard and would silently replicate the full "
+          f"storage on every device. Use the nearest divisible "
+          f"capacity ({mesh_lib.nearest_multiples(capacity, axis_size)}), "
+          "or pass shard_capacity=False for a "
+          "deliberately replicated ring.")
     self._capacity_sharding = (
-        mesh_lib.batch_sharding(self.mesh, data_axis)
-        if capacity % axis_size == 0 else self._replicated)
+        mesh_lib.ring_sharding(self.mesh, data_axis)
+        if shard_capacity else self._replicated)
+    _LOG.info(
+        "DeviceReplayBuffer layout: capacity %d %s %r axis "
+        "(%d device(s), %s slots/device), ingest_chunk %d, "
+        "sample_batch %d",
+        capacity,
+        "sharded over" if shard_capacity and axis_size > 1
+        else "replicated on",
+        data_axis, axis_size,
+        capacity // axis_size if shard_capacity else capacity,
+        ingest_chunk, sample_batch_size)
     self._lock = threading.Lock()
     self._pending: Dict[str, list] = {key: [] for key in self._spec}
     self._pending_count = 0
@@ -529,7 +558,8 @@ class DeviceReplayBuffer:
 
 
 def make_learn_iteration_fn(model, step_fn, sample, update_priorities,
-                            targets_fn, target_key, clip_targets):
+                            targets_fn, target_key, clip_targets,
+                            constrain_batch=None):
   """ONE sample→CEM-Bellman-label→train→reprioritize iteration as a
   pure closure — THE learner inner body, extracted so the megastep
   (which lax.scans it K times) and the fused Anakin loop
@@ -542,11 +572,23 @@ def make_learn_iteration_fn(model, step_fn, sample, update_priorities,
    label_keys) -> (train_state', buffer_state', metrics). RNG
   derivation stays with the CALLER (each loop owns its key schedule);
   this body is deterministic given the keys.
+
+  constrain_batch: optional pytree->pytree hook applied to the sampled
+  batch BEFORE labeling/training. The mesh-native Anakin loop passes a
+  `with_sharding_constraint` onto the data axis here, so the sampled
+  gather out of the capacity-sharded ring re-lands batch-split across
+  the mesh and the whole label→grad→apply chain runs data-parallel
+  (XLA inserts the gradient all-reduce against the replicated params,
+  exactly as in Trainer's supervised path). None (the megastep's
+  single-shape contract, where sample_batch_size need not divide the
+  axis) leaves placement to propagation.
   """
 
   def learn(train_state, buffer_state, target_variables, sample_key,
             label_keys):
     batch, indices, _, staleness = sample(buffer_state, sample_key)
+    if constrain_batch is not None:
+      batch = constrain_batch(batch)
     targets, q_next = targets_fn(
         target_variables, batch["next_image"], batch["reward"],
         batch["done"], label_keys)

@@ -299,6 +299,21 @@ class ReplayLoopConfig:
   anakin_inner: int = 40
   anakin_train_every: int = 8
   anakin_bank_scenes: int = 512
+  # Pod-scale mesh (ISSUE 7): mesh_dp > 0 pins an explicit dp×tp mesh
+  # ({"data": mesh_dp, "model": mesh_tp} over the first dp*tp devices)
+  # instead of the Trainer default (ALL visible devices on the data
+  # axis). On a dp > 1 mesh the anakin path runs fully sharded: env
+  # fleet split per shard, replay ring capacity-sharded per device,
+  # learn body data-parallel with gradient all-reduce. The fleet width
+  # (num_collectors * envs_per_collector), batch_size, and capacity
+  # must all divide mesh_dp — the loop refuses indivisible sizes with
+  # the fix named. zero1=None resolves to (mesh_dp > 1): ZeRO-1
+  # cross-replica weight-update sharding (Trainer's
+  # shard_optimizer_state) is on for pod runs, off on the unchanged
+  # single-device oracle path.
+  mesh_dp: int = 0
+  mesh_tp: int = 1
+  zero1: Optional[bool] = None
 
 
 class ReplayTrainLoop:
@@ -320,7 +335,25 @@ class ReplayTrainLoop:
     self.config = config
     self.logdir = logdir
     self.model = model if model is not None else self._default_model()
-    self.trainer = Trainer(self.model, seed=config.seed)
+    mesh = None
+    if config.mesh_dp:
+      import jax
+      from tensor2robot_tpu.parallel import mesh as mesh_lib
+      needed = config.mesh_dp * config.mesh_tp
+      devices = jax.devices()
+      if len(devices) < needed:
+        raise ValueError(
+            f"mesh {config.mesh_dp}x{config.mesh_tp} needs {needed} "
+            f"device(s), have {len(devices)}. On a chipless host run "
+            "the smoke lane (which bootstraps a virtual CPU mesh) or "
+            "shrink the mesh.")
+      mesh = mesh_lib.create_mesh(
+          {"data": config.mesh_dp, "model": config.mesh_tp},
+          devices=devices[:needed])
+    zero1 = (config.zero1 if config.zero1 is not None
+             else config.mesh_dp > 1)
+    self.trainer = Trainer(self.model, mesh=mesh, seed=config.seed,
+                           shard_optimizer_state=zero1)
     self.writer = MetricWriter(logdir)
     spec = transition_spec(config.image_size, config.action_size)
     if config.device_resident or config.anakin:
@@ -863,6 +896,8 @@ class ReplayTrainLoop:
         anakin=True,
         anakin_inner=c.anakin_inner,
         anakin_train_every=c.anakin_train_every,
+        mesh_shape=loop.mesh_shape,
+        zero1=self.trainer.shards_optimizer_state,
         episodes_collected=loop.episodes,
         env_steps_collected=loop.env_steps,
         collector_success_rate=(loop.successes

@@ -306,6 +306,23 @@ class JaxGraspEnv:
         episodes=jnp.zeros((), jnp.int32),
         successes=jnp.zeros((), jnp.int32))
 
+  def state_shardings(self, mesh, axis: str = "data") -> JaxGraspState:
+    """Sharding pytree for JaxGraspState on a dp mesh: the fleet-width
+    leading dim of every per-env leaf (images, targets, attempts)
+    splits over `axis` via `parallel.mesh.env_sharding` — each device
+    owns num_envs / axis_size envs of the fleet, the Podracer per-core
+    environment slice — while the cursor/episode scalars stay
+    replicated (one global seed-stream counter, exactly the oracle's
+    shared monotonic counter, so scene assignment is identical to the
+    single-device stream)."""
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+    fleet = mesh_lib.env_sharding(mesh, axis)
+    replicated = mesh_lib.replicated_sharding(mesh)
+    return JaxGraspState(
+        images=fleet, targets=fleet, attempts=fleet,
+        next_scene=replicated, episodes=replicated,
+        successes=replicated)
+
   def step_fn(self):
     """Pure (state, actions, key) -> (state', (rewards, dones, truncated)).
 

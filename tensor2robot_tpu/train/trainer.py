@@ -394,6 +394,21 @@ class Trainer:
     """Public sharding for batched inputs (prefetch/infeed consumers)."""
     return self._batch_sharding
 
+  @property
+  def shards_optimizer_state(self) -> bool:
+    """True when ZeRO-1 weight-update sharding is active. Fused
+    consumers that inline `train_step_fn` into their own executables
+    (replay/anakin.py) inherit it automatically — the in-body
+    constraints ride along with the body — and record this flag in
+    their result artifacts."""
+    return self._shard_opt
+
+  @property
+  def data_axis_size(self) -> int:
+    """Devices on the data axis — the DP degree fused consumers must
+    divide their fleet/batch sizes by."""
+    return self.mesh.shape[self.data_axis]
+
   def shard_batch(self, batch: Any) -> Any:
     """Host batch → mesh, split over the data axis (the infeed)."""
     return mesh_lib.shard_batch(self.mesh, batch, self.data_axis)

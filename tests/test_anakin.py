@@ -311,20 +311,33 @@ class TestExtendInsideJittedScan:
 class _AnakinSetup:
 
   def build(self, num_envs=4, inner_steps=8, train_every=2,
-            min_fill=0, seed=0, factored=True):
+            min_fill=0, seed=0, factored=True, num_devices=1,
+            capacity=64, batch=8, zero1=None):
+    """Builds the fused-loop quartet on an EXPLICIT num_devices dp
+    mesh. The default (1 device) is the oracle configuration the
+    structural tests pin; the sharded-parity suite passes
+    num_devices=8 (the harness's full virtual mesh) with zero1
+    defaulting to num_devices > 1 — the production pod shape."""
     from tensor2robot_tpu.export import export_utils
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
     from tensor2robot_tpu.replay.anakin import AnakinLoop
     from tensor2robot_tpu.train.trainer import Trainer
     model = TinyQCriticModel(image_size=IMG,
                              optimizer_fn=lambda: optax.adam(1e-3))
     if not factored:
       model.factored_cem_fns = lambda: None  # generic tiled path
-    trainer = Trainer(model, seed=seed)
-    state = trainer.create_train_state(batch_size=8)
+    mesh = mesh_lib.create_mesh({"data": num_devices},
+                                devices=jax.devices()[:num_devices])
+    if zero1 is None:
+      zero1 = num_devices > 1
+    trainer = Trainer(model, mesh=mesh, seed=seed,
+                      shard_optimizer_state=zero1)
+    state = trainer.create_train_state(batch_size=batch)
     variables = export_utils.fetch_variables_to_host(
         state.variables(use_ema=True))
     buf = DeviceReplayBuffer(
-        transition_spec(IMG, 4), capacity=64, sample_batch_size=8,
+        transition_spec(IMG, 4), capacity=capacity,
+        sample_batch_size=batch,
         seed=seed, prioritized=True, ingest_chunk=num_envs,
         mesh=trainer.mesh)
     bank = jg.make_scene_bank(64, image_size=IMG, base_seed=seed)
@@ -398,6 +411,96 @@ class TestAnakinLoop(_AnakinSetup):
     with pytest.raises(ValueError, match="multiple"):
       AnakinLoop(loop._model, loop._trainer, buf, env,
                  inner_steps=8, train_every=3)
+
+
+class TestShardedAnakinParity(_AnakinSetup):
+  """ISSUE 7: the fused executable over the full 8-virtual-device dp
+  mesh vs the 1-device semantics oracle, SAME seeds, same global
+  stream (8 envs — one per shard at dp=8).
+
+  The parity contract, documented where exactness ends:
+  - BIT-IDENTICAL across mesh shapes: acting/exploration/env-reset/
+    label randomness (one GLOBAL fold_in key stream; each device
+    materializes its slice), scene assignment (replicated cursor), env
+    stepping, ring contents, episode bookkeeping. Pinned below on a
+    pre-training dispatch (min-fill gate held shut), where no
+    cross-replica reduction exists.
+  - TOLERANCE-BOUND once training fires: the gradient all-reduce (and
+    mean-TD metrics) sum float32 partials in a different order on 8
+    shards than on 1 device — float addition is non-associative, so
+    exact parity is IMPOSSIBLE by construction there (the reference's
+    CrossShardOptimizer had the same property). Measured divergence is
+    ~1e-7 relative per dispatch on this suite; asserted at 1e-4
+    relative over 3 dispatches as the documented loose bound.
+  """
+
+  def test_pretrain_stream_bit_identical_across_meshes(self):
+    outs = {}
+    for ndev in (1, 8):
+      state, loop, buf, _ = self.build(
+          num_envs=8, capacity=128, min_fill=10**6, num_devices=ndev)
+      state, metrics = loop.step(state)
+      assert metrics["trained_steps"] == 0  # the gate held: pure stream
+      outs[ndev] = (
+          {key: np.asarray(value)
+           for key, value in buf.state.storage.items()},
+          np.asarray(loop._env_state.images),
+          np.asarray(loop._env_state.targets),
+          loop.episodes, loop.successes)
+    storage_1, images_1, targets_1, episodes_1, successes_1 = outs[1]
+    storage_8, images_8, targets_8, episodes_8, successes_8 = outs[8]
+    for key in storage_1:
+      np.testing.assert_array_equal(storage_1[key], storage_8[key],
+                                    err_msg=key)
+    np.testing.assert_array_equal(images_1, images_8)
+    np.testing.assert_array_equal(targets_1, targets_8)
+    assert episodes_1 == episodes_8 and successes_1 == successes_8
+    assert episodes_1 > 0  # the stream actually crossed resets
+
+  def test_trained_trajectories_match_within_collective_tolerance(self):
+    streams = {}
+    for ndev in (1, 8):
+      state, loop, buf, _ = self.build(
+          num_envs=8, capacity=128, min_fill=8, num_devices=ndev)
+      metrics_stream = []
+      for _ in range(3):
+        state, metrics = loop.step(state)
+        metrics_stream.append(metrics)
+      streams[ndev] = metrics_stream
+      # Still exactly ONE fused executable on the pod mesh.
+      assert loop.compile_counts == {"anakin_step": 1}
+    for metrics_1, metrics_8 in zip(streams[1], streams[8]):
+      assert metrics_1["trained_steps"] == metrics_8["trained_steps"]
+      for key in ("loss", "td_error", "q_next", "staleness"):
+        np.testing.assert_allclose(
+            metrics_1[key], metrics_8[key], rtol=1e-4, atol=1e-6,
+            err_msg=f"{key}: beyond collective-reduction tolerance")
+
+  def test_sharded_placements_and_zero1(self):
+    """The pod run actually shards: env fleet + ring storage split
+    over the data axis, some optimizer-state leaf splits (ZeRO-1),
+    params replicated."""
+    from jax.sharding import PartitionSpec
+    state, loop, buf, _ = self.build(
+        num_envs=8, capacity=128, min_fill=8, num_devices=8)
+    assert tuple(buf.state.storage["image"].sharding.spec) == ("data",)
+    assert tuple(loop._env_state.images.sharding.spec) == ("data",)
+    state, _ = loop.step(state)
+    leaves = jax.tree_util.tree_leaves(state.params)
+    assert all(leaf.sharding.is_fully_replicated for leaf in leaves)
+    opt_specs = {tuple(leaf.sharding.spec)
+                 for leaf in jax.tree_util.tree_leaves(state.opt_state)
+                 if hasattr(leaf, "sharding")}
+    assert any("data" in spec for spec in opt_specs), opt_specs
+
+  def test_refuses_indivisible_fleet_and_batch(self):
+    """Actionable divisibility errors name the nearest fix (the
+    ring-sharding refusal discipline applied to fleet and batch)."""
+    with pytest.raises(ValueError,
+                       match="fleet width 4 .*Use a fleet of 8"):
+      self.build(num_envs=4, capacity=128, num_devices=8)
+    with pytest.raises(ValueError, match="sample batch 12 .*8 or 16"):
+      self.build(num_envs=8, capacity=128, batch=12, num_devices=8)
 
 
 @pytest.fixture(scope="module")
@@ -514,3 +617,165 @@ class TestAnakinSmokeCLI:
                 "replay/target_lag", "replay/eval_td_error",
                 "replay/train_loss", "replay/env_steps"):
       assert key in seen, (key, sorted(seen))
+
+
+def _run_cli_subprocess(args, tmp, timeout=480):
+  """The artifact-environment subprocess protocol shared by the
+  sharded smokes: JAX_PLATFORMS=cpu, XLA_FLAGS stripped — a CLI that
+  needs a multi-device mesh must BOOTSTRAP it (the re-exec path under
+  test), exactly as a user invocation would."""
+  import subprocess
+  import sys
+  out = tmp / "out.json"
+  env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+  env["JAX_PLATFORMS"] = "cpu"
+  root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+  res = subprocess.run(
+      [sys.executable, *args, "--out", str(out)],
+      capture_output=True, text=True, timeout=timeout, env=env,
+      cwd=root)
+  assert res.returncode == 0, res.stderr[-2000:]
+  lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+  assert len(lines) == 1, res.stdout  # the ONE-JSON-line contract
+  results = json.loads(lines[0])
+  assert json.loads(out.read_text()) == results
+  return results
+
+
+@pytest.fixture(scope="module")
+def sharded_smoke_results(tmp_path_factory):
+  """The r10 SHARDED smoke protocol in a subprocess: `--mesh 8,1`
+  forces the CLI's virtual-CPU-mesh bootstrap (re-exec with the
+  canonical env), then runs the fused loop over the 8-device dp mesh.
+  Reduced step budget + no anakin-bench block: this fixture gates the
+  sharded path's structure/learning claims; the full-protocol numbers
+  live in the committed REPLAY_SMOKE_r10.json."""
+  tmp = tmp_path_factory.mktemp("sharded_smoke")
+  return _run_cli_subprocess(
+      ["-m", "tensor2robot_tpu.bin.run_qtopt_replay", "--smoke",
+       "--anakin", "--mesh", "8,1", "--steps", "150",
+       "--no-anakin-bench", "--logdir", str(tmp / "logs")], tmp)
+
+
+class TestShardedAnakinSmokeCLI:
+  """ISSUE 7 acceptance: the SHARDED fused loop still learns (>= 30%
+  eval TD bar), still compiles exactly ONE anakin_step, still does
+  zero host-side transition work — structure + ledger asserted
+  everywhere (no timing bars here: those live in the committed
+  artifacts and the multichip CLI's gated asserts)."""
+
+  def test_mesh_and_zero1_recorded(self, sharded_smoke_results):
+    results = sharded_smoke_results
+    assert results["anakin"] is True
+    assert results["mesh_shape"] == {"data": 8, "model": 1}
+    assert results["zero1"] is True
+
+  def test_td_reduction_through_sharded_loop(self, sharded_smoke_results):
+    results = sharded_smoke_results
+    assert results["steps"] >= 150
+    assert results["eval_td_reduction"] >= 0.30, results["eval_history"]
+
+  def test_ledger_one_executable_on_the_pod_mesh(self,
+                                                 sharded_smoke_results):
+    ledger = sharded_smoke_results["compile_counts"]
+    assert ledger["anakin_step"] == 1
+    for absent in ("megastep", "train_step", "device_extend"):
+      assert absent not in ledger, ledger
+    assert all(value == 1 for value in ledger.values()), ledger
+
+  def test_host_never_touches_a_transition(self, sharded_smoke_results):
+    results = sharded_smoke_results
+    stats = results["queue"]
+    assert stats["enqueued"] == 0 and stats["dequeued"] == 0
+    assert results["env_steps_collected"] > 0
+    assert results["episodes_collected"] > 0
+
+  def test_parse_mesh_flag(self):
+    from tensor2robot_tpu.bin.run_qtopt_replay import parse_mesh
+    assert parse_mesh("8") == (8, 1)
+    assert parse_mesh("4,2") == (4, 2)
+    assert parse_mesh("0") == (0, 1)
+    for bad in ("8,2,1", "a", "8,-1", "0,2"):
+      with pytest.raises(ValueError):
+        parse_mesh(bad)
+
+
+@pytest.fixture(scope="module")
+def multichip_bench_results(tmp_path_factory):
+  """The scaling-ladder CLI at its two endpoints (1 and 8 devices):
+  structure everywhere; the full 1/2/4/8 ladder is the committed
+  MULTICHIP_r06.json."""
+  tmp = tmp_path_factory.mktemp("multichip_bench")
+  return _run_cli_subprocess(
+      ["-m", "tensor2robot_tpu.replay.anakin_multichip_bench",
+       "--smoke", "--devices", "1,8"], tmp)
+
+
+class TestAnakinMultichipBenchCLI:
+  """ISSUE 7: the MULTICHIP_r06-schema block. Structure + per-scale
+  one-executable ledger asserted everywhere; the only quantitative
+  bars (host-blocked, a token efficiency floor) are gated on
+  `os.cpu_count() >= 4` per the repo-wide timing-bar rule — on the
+  virtual mesh efficiency measures partitioning overhead, so no
+  near-linear bar exists chiplessly by design."""
+
+  def test_block_structure(self, multichip_bench_results):
+    results = multichip_bench_results
+    assert results["probed_device_kind"] == "cpu"
+    assert results["virtual_mesh"] is True
+    assert results["device_counts"] == [1, 8]
+    assert len(results["scales"]) == 2
+    for scale in results["scales"]:
+      for field in ("env_steps_per_sec", "transitions_per_sec",
+                    "per_device_transitions_per_sec",
+                    "train_steps_per_sec", "host_blocked_fraction"):
+        assert set(scale[field]) == {"median", "min", "max",
+                                     "trials"}, field
+      assert scale["compile_counts"] == {"anakin_step": 1}
+      assert np.isfinite(scale["scaling_efficiency_vs_1dev"])
+      assert scale["scaling_efficiency_vs_1dev"] > 0
+    assert results["scales"][0]["devices"] == 1
+    assert results["scales"][0]["zero1"] is False
+    assert results["scales"][1]["devices"] == 8
+    assert results["scales"][1]["zero1"] is True
+    assert results["scales"][0]["scaling_efficiency_vs_1dev"] == 1.0
+
+  def test_fixed_global_workload_recorded(self, multichip_bench_results):
+    results = multichip_bench_results
+    # One global workload across scales — the whole point of the
+    # ladder; per-device == global / d at each scale.
+    for scale in results["scales"]:
+      ratio = (scale["transitions_per_sec"]["median"]
+               / max(scale["per_device_transitions_per_sec"]["median"],
+                     1e-9))
+      assert abs(ratio - scale["devices"]) / scale["devices"] < 0.05
+
+  def test_gated_quantitative_bars(self, multichip_bench_results):
+    results = multichip_bench_results
+    for scale in results["scales"]:
+      # Zero-host-work holds at every scale (sub-ms bookkeeping vs
+      # multi-second dispatches keeps this off the noise floor even
+      # on the 2-core box).
+      assert scale["host_blocked_fraction"]["median"] <= 0.05
+    if (os.cpu_count() or 1) >= 4:
+      # Token floor only: virtual-mesh partitioning overhead is the
+      # measured quantity chiplessly (documented in the note field).
+      assert results["scales"][-1]["scaling_efficiency_vs_1dev"] >= 0.005
+
+  def test_committed_artifact_matches_schema(self):
+    """MULTICHIP_r06.json (the committed acceptance artifact) parses
+    against the same schema the live CLI just produced — the
+    machine-check that keeps the artifact from going stale."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "MULTICHIP_r06.json")) as f:
+      artifact = json.load(f)
+    assert artifact["virtual_mesh"] is True
+    assert artifact["device_counts"] == [1, 2, 4, 8]
+    assert [s["devices"] for s in artifact["scales"]] == [1, 2, 4, 8]
+    for scale in artifact["scales"]:
+      assert scale["compile_counts"] == {"anakin_step": 1}
+      assert set(scale["env_steps_per_sec"]) == {"median", "min",
+                                                 "max", "trials"}
+      assert scale["host_blocked_fraction"]["median"] <= 0.05
+    assert artifact["scales"][0]["scaling_efficiency_vs_1dev"] == 1.0

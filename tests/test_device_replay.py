@@ -168,14 +168,26 @@ class TestDeviceReplayBuffer:
 
   def test_capacity_sharding_uses_mesh_rule(self):
     """capacity % data axis == 0 -> storage shards over capacity via
-    the existing batch rule; indivisible -> replicated fallback."""
+    the ring rule; indivisible -> REFUSED with the nearest divisible
+    capacities named (ISSUE 7: the silent replicated fallback would
+    quietly hold the FULL ring on every chip of a pod run);
+    shard_capacity=False is the explicit opt-in to replication."""
     from jax.sharding import PartitionSpec
     sharded = _device_buffer(capacity=16)
     spec = sharded.state.storage["image"].sharding.spec
     assert tuple(spec) == tuple(PartitionSpec("data"))
-    replicated = _device_buffer(capacity=12, batch=4)
+    with pytest.raises(ValueError, match=r"capacity 12 .*8 or 16"):
+      _device_buffer(capacity=12, batch=4)
+    replicated = _device_buffer(capacity=12, batch=4,
+                                shard_capacity=False)
     spec = replicated.state.storage["image"].sharding.spec
     assert tuple(spec) == tuple(PartitionSpec())
+
+  def test_capacity_refusal_names_axis_size_when_below(self):
+    """capacity < axis size has no lower multiple: the error names
+    the axis size itself as the fix."""
+    with pytest.raises(ValueError, match="capacity 3 .*\\(8\\)"):
+      _device_buffer(capacity=3, batch=2)
 
   def test_validation_at_the_door(self):
     buf = _device_buffer()
