@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 10
+ROUND = 11
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -986,6 +986,30 @@ def _bench_anakin_multichip_compact():
   return measure_anakin_multichip()
 
 
+def _bench_fleet_compact():
+  """Fleet-serving block for the bench detail (ISSUE 10).
+
+  Same driver-refreshable rationale as the serving block: the
+  committed FLEET_r11.json carries the chipless 128-client protocol
+  (8-virtual-device mesh), but a driver-only chip window should still
+  re-measure the routed fleet — SLO classes under open-loop Poisson
+  load, the deterministic overload burst, both rollout cycles, and the
+  one-executable-per-bucket-PER-DEVICE ledger — on whatever devices
+  the window offers (a single chip honestly collapses to 1 replica).
+  Reduced clients/windows: this is the driver-path sentinel, the full
+  sweep stays serving/fleet_bench's job. CPU-probe results never reach
+  this block: the orchestrator's cpu_fallback guard (PR 1 convention)
+  rejects a CPU claim before main() runs.
+  """
+  from tensor2robot_tpu.serving.fleet_bench import R11_CLASSES, measure_fleet
+  return measure_fleet(
+      classes=tuple((slo_class, max(4, clients // 4), hz)
+                    for slo_class, clients, hz in R11_CLASSES),
+      load_multipliers=(1.0,), duration_s=2.0, max_queue=32,
+      rollout_cycle_s=5.0, rollout_mirror=1.0, rollout_canary=0.5,
+      rollout_min_shadow=8, rollout_min_canary=4)
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1118,6 +1142,11 @@ def main() -> None:
     serving = {"error": f"{type(e).__name__}: {e}"}
 
   try:
+    fleet = _bench_fleet_compact()
+  except Exception as e:
+    fleet = {"error": f"{type(e).__name__}: {e}"}
+
+  try:
     learner = _bench_learner_compact()
   except Exception as e:
     learner = {"error": f"{type(e).__name__}: {e}"}
@@ -1191,6 +1220,7 @@ def main() -> None:
       "variants": variants,
       "input_pipeline": input_pipeline,
       "serving": serving,
+      "fleet": fleet,
       "learner": learner,
       "actor": actor,
       "anakin": anakin,
@@ -1218,6 +1248,12 @@ def main() -> None:
           "speedup", {}).get("median"),
       "anakin_env_steps_speedup": anakin.get(
           "speedup", {}).get("median"),
+      # Fleet-serving sentinels (ISSUE 10): min per-class p99 headroom
+      # at the block's top offered-load point, and the client count it
+      # sustained with every class inside budget. Null-safe under
+      # outage/error like every compact key.
+      "fleet_p99_headroom": fleet.get("fleet_p99_headroom"),
+      "fleet_clients_sustained": fleet.get("fleet_clients_sustained"),
       # A single-entry ladder (1-chip window) scores 1.0 against itself
       # by construction — publish null rather than fake linear scaling.
       "anakin_multichip_scaling_efficiency": (

@@ -38,6 +38,13 @@
 #                                fused executable at 1/2/4/8 virtual
 #                                devices, fixed global workload;
 #                                virtual_mesh caveat inside)
+#   FLEET_r0N.json               serving/fleet_bench --smoke (CHIPLESS
+#                                backstop too — ISSUE 10: SLO-class
+#                                offered-load sweep at 128 clients on
+#                                the 8-virtual-device mesh, overload
+#                                burst, shadow/canary rollout cycles,
+#                                per-device compile ledger; normally
+#                                builder-committed and skipped)
 #   BENCH_DETAIL_r0N.json        bench.py (orchestrator; also emits the
 #                                compact line, saved to BENCH_builder_r0N.json)
 #   SERVING_r0N.json             bin/bench_serving single-robot + --fleet lines
@@ -136,6 +143,23 @@ else
   done
   run_stage "MULTICHIP_r06.json" 1800 sh -c '
     python -m tensor2robot_tpu.replay.anakin_multichip_bench --smoke \
+      --out "$STAGE_TMP"'
+fi
+# Third chipless backstop (ISSUE 10): the fleet-serving protocol —
+# SLO-class offered-load sweep + deterministic overload burst + both
+# rollout cycles on the 8-virtual-device mesh, 128 clients. Normally
+# builder-committed and skipped; same tmp→mv atomicity and pytest
+# deferral rules (its per-class p99 bars are timing measurements).
+if [ -s "FLEET_${RTAG}.json" ]; then
+  log "skip FLEET_${RTAG}.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring fleet backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "FLEET_${RTAG}.json" 1800 sh -c '
+    python -m tensor2robot_tpu.serving.fleet_bench --smoke \
       --out "$STAGE_TMP"'
 fi
 while [ "$(date +%s)" -lt "$deadline" ]; do

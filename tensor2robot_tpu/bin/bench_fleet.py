@@ -1,0 +1,21 @@
+"""Fleet-serving benchmark CLI: the bin/ face of serving/fleet_bench.
+
+    # The committed FLEET_r11 protocol (chipless: the CLI bootstraps an
+    # 8-virtual-device CPU mesh and re-execs itself):
+    python -m tensor2robot_tpu.bin.bench_fleet --smoke --out FLEET_r11.json
+
+    # Reduced tier-1 lane (2 devices, short windows, same structure):
+    python -m tensor2robot_tpu.bin.bench_fleet --ci
+
+Everything — the offered-load sweep across SLO classes, the overload
+burst, the shadow/canary rollout cycles, the per-device compile ledger
+— lives in serving/fleet_bench.py; this wrapper exists so the fleet
+protocol is discoverable next to bench_serving (the single-replica
+oracle's sweep) in the bin/ surface every other measured artifact is
+produced from.
+"""
+
+from tensor2robot_tpu.serving.fleet_bench import main
+
+if __name__ == "__main__":
+  main()

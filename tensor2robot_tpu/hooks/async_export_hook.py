@@ -29,10 +29,25 @@ class AsyncExportHook(Hook):
   """Exports on checkpoint saves via a worker thread."""
 
   def __init__(self, export_generator, keep: int = 5,
-               shutdown_timeout_s: float = 180.0):
+               shutdown_timeout_s: float = 180.0,
+               on_export=None):
+    """Args:
+      export_generator: the artifact writer (set_specification + export).
+      keep: versions retained after GC.
+      shutdown_timeout_s: end-of-training drain bound.
+      on_export: optional callable ``(export_dir, step)`` invoked on the
+        worker thread after each successful publish — the push half of
+        the learner→server rollout plumbing: a co-resident
+        ``serving.rollout.RolloutController`` wires its watcher's
+        ``notify`` here and starts the shadow evaluation the moment a
+        checkpoint lands, instead of on the next poll tick. Exceptions
+        are logged and never fail the export (serving trouble must not
+        stall training).
+    """
     self._generator = export_generator
     self._keep = keep
     self._shutdown_timeout_s = shutdown_timeout_s
+    self._on_export = on_export
     # maxsize=1 + replace-on-full: at most one pending export.
     self._pending: "queue.Queue" = queue.Queue(maxsize=1)
     self._worker: Optional[threading.Thread] = None
@@ -96,6 +111,12 @@ class AsyncExportHook(Hook):
             self._generator, variables, keep=self._keep, global_step=step)
         if export_dir is not None:
           _log.info("Async export published %s", export_dir)
+          if self._on_export is not None:
+            try:
+              self._on_export(export_dir, step)
+            except Exception:
+              _log.exception("on_export callback failed; training "
+                             "continues.")
       except Exception:
         _log.exception("Async export failed; training continues.")
 
@@ -142,11 +163,13 @@ class AsyncExportHookBuilder(HookBuilder):
   §AsyncExportHookBuilder)."""
 
   def __init__(self, export_generator, keep: int = 5,
-               shutdown_timeout_s: float = 180.0):
+               shutdown_timeout_s: float = 180.0, on_export=None):
     self._export_generator = export_generator
     self._keep = keep
     self._shutdown_timeout_s = shutdown_timeout_s
+    self._on_export = on_export
 
   def create_hooks(self, trainer, model_dir: str) -> List[Hook]:
     return [AsyncExportHook(self._export_generator, keep=self._keep,
-                            shutdown_timeout_s=self._shutdown_timeout_s)]
+                            shutdown_timeout_s=self._shutdown_timeout_s,
+                            on_export=self._on_export)]

@@ -73,6 +73,16 @@ def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
   return NamedSharding(mesh, PartitionSpec(axis))
 
 
+def mesh_devices(mesh: Mesh) -> list:
+  """The mesh's devices as a flat row-major list — the serving fleet's
+  replica enumeration (serving/router.py places one bucket-ladder
+  replica per entry). Row-major matches create_mesh's layout, so
+  replica i of a dp×tp mesh is the same physical chip the training
+  side addresses at flat index i — one device numbering for both
+  halves of the learner→server loop."""
+  return list(mesh.devices.flat)
+
+
 def nearest_multiples(value: int, divisor: int) -> str:
   """'8 or 16'-style fix suggestion for a size that must divide a mesh
   axis — ONE phrasing for every divisibility-refusal message (ring

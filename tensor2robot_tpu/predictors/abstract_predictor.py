@@ -75,6 +75,37 @@ class AbstractPredictor(abc.ABC):
     raise NotImplementedError(
         f"{type(self).__name__} does not support init_randomly.")
 
+  def set_variables(self, variables,
+                    version: Optional[int] = None) -> None:
+    """Hot-swaps the served params in place (same tree structure/shapes).
+
+    The rollout controller's promotion path (serving/rollout.py): a
+    canary-validated candidate cuts over by swapping the variables the
+    predictor hands out — an atomic pointer swap under the GIL.
+    `version` is the candidate's step in the SAME namespace
+    model_version lives in (checkpoint/export global step): passing it
+    keeps restore()'s newest-wins staleness check honest — without it,
+    a promotion from export step 250 onto a predictor at checkpoint
+    step 100 would leave model_version at 101, and a later restore()
+    poll finding checkpoint 150 would silently overwrite the promoted
+    params with OLDER ones. When None, the version bumps by one
+    (in-memory predictors with counter versions). Implementations
+    clamp to stay monotonic. Compiled consumers (the fleet policies'
+    bucket executables, AOT CEM programs) take variables as an
+    ARGUMENT, so a swap is never a recompile; the hot-reload ledger
+    test pins that. Optional: predictors whose params live inside an
+    opaque artifact (e.g. a TF SavedModel) raise, and rollout for them
+    goes through restore() on a new artifact instead.
+    """
+    raise NotImplementedError(
+        f"{type(self).__name__} does not support in-place variable "
+        "hot-swap; publish a new export and call restore().")
+
+  def _next_swap_version(self, version: Optional[int]) -> int:
+    """Monotonic model_version for a set_variables swap (shared rule)."""
+    bumped = self.model_version + 1
+    return bumped if version is None else max(bumped, int(version))
+
   def device_fn(self):
     """Device-resident serving entry for jit-composed policies.
 

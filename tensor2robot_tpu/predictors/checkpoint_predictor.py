@@ -93,6 +93,37 @@ class CheckpointPredictor(AbstractPredictor):
     if self._predict is None:
       self._predict = self._build_predict()
 
+  def set_variables(self, variables,
+                    version: Optional[int] = None) -> None:
+    """See AbstractPredictor.set_variables: the rollout promotion path.
+    Structure must match the loaded tree — a mismatched candidate must
+    fail HERE (actionable), not as a shape error inside some replica's
+    next flush. Pass the candidate's export step as `version` so a
+    later restore() poll cannot mistake an older on-disk checkpoint
+    for news."""
+    self.assert_is_loaded()
+
+    def check(old, new):
+      if np.shape(old) != np.shape(new):
+        raise ValueError(
+            f"hot-swap shape mismatch: {np.shape(old)} -> "
+            f"{np.shape(new)} (a reshaped candidate would recompile "
+            "every bucket executable; promote via a new export "
+            "instead).")
+      old_dtype = np.asarray(old).dtype
+      new_dtype = np.asarray(new).dtype
+      if old_dtype != new_dtype:
+        raise ValueError(
+            f"hot-swap dtype mismatch: {old_dtype} -> {new_dtype} "
+            "(the fleet's AOT executables were compiled against the "
+            "old avals; a dtype change would fail every replica's "
+            "next flush — promote via a new export instead).")
+      return new
+
+    checked = jax.tree_util.tree_map(check, self._variables, variables)
+    self._variables = jax.tree_util.tree_map(jax.numpy.asarray, checked)
+    self._version = self._next_swap_version(version)
+
   def predict(
       self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     self.assert_is_loaded()

@@ -9,27 +9,61 @@ feeding one batched on-device step. This package is that layer:
 - ``BucketLadder`` (bucketing.py): pad pending requests up to a small
   fixed ladder of batch sizes so the compiled-executable count is
   bounded and no request ever triggers a recompile;
+- ``SLOClass`` (slo.py): priority/deadline service classes — EDF
+  admission, lowest-priority-first shedding with per-class accounting;
 - ``MicroBatcher`` (batcher.py): concurrent clients enqueue frames, the
-  dispatcher flushes when a batch fills or the oldest request's
-  deadline budget expires;
+  dispatcher flushes when a batch fills or the earliest pending
+  deadline's budget expires; overload sheds instead of collapsing;
 - ``CEMFleetPolicy`` (policy.py): the sample→score→elite-refit CEM loop
-  vmapped across clients inside ONE compiled program per bucket;
+  vmapped across clients inside ONE compiled program per bucket (per
+  device, when pinned);
 - ``FleetServer`` (server.py): batcher + policy + per-request latency
-  histograms / occupancy counters, exportable via utils/metric_writer.
+  histograms / occupancy counters, exportable via utils/metric_writer —
+  the single-replica semantics oracle;
+- ``FleetRouter`` (router.py): the ladder replicated onto every mesh
+  device behind least-loaded dispatch — fleet traffic;
+- ``RolloutController`` (rollout.py): learner checkpoints walked
+  through shadow→canary→promote on mirrored live traffic, with
+  auto-rollback and a recorded event timeline.
 """
 
 from tensor2robot_tpu.serving.batcher import MicroBatcher
 from tensor2robot_tpu.serving.bucketing import BucketLadder, DEFAULT_LADDER
 from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+from tensor2robot_tpu.serving.rollout import (
+    ExportWatcher,
+    RolloutConfig,
+    RolloutController,
+)
+from tensor2robot_tpu.serving.router import FleetRouter, PolicyReplica
 from tensor2robot_tpu.serving.server import FleetServer
+from tensor2robot_tpu.serving.slo import (
+    BATCH,
+    DEFAULT_CLASSES,
+    INTERACTIVE,
+    STANDARD,
+    RequestShed,
+    SLOClass,
+)
 from tensor2robot_tpu.serving.stats import LatencyHistogram, ServingStats
 
 __all__ = [
+    "BATCH",
     "BucketLadder",
     "CEMFleetPolicy",
+    "DEFAULT_CLASSES",
     "DEFAULT_LADDER",
+    "ExportWatcher",
+    "FleetRouter",
     "FleetServer",
+    "RolloutConfig",
+    "RolloutController",
+    "INTERACTIVE",
     "LatencyHistogram",
     "MicroBatcher",
+    "PolicyReplica",
+    "RequestShed",
+    "STANDARD",
+    "SLOClass",
     "ServingStats",
 ]

@@ -1,70 +1,134 @@
-"""Deadline-driven micro-batcher for multi-client inference.
+"""Deadline-driven, SLO-aware micro-batcher for multi-client inference.
 
 Concurrent clients enqueue one item each (``submit`` returns a Future);
-a single dispatcher thread flushes the queue into ``batch_fn`` when
-either (a) ``max_batch`` requests are pending, or (b) the OLDEST pending
-request's deadline budget has expired — so a lone robot never waits
-longer than the deadline, and a busy fleet always ships full batches.
-Requests are strictly FIFO: a flush takes the head of the queue, never
-reorders, so no client can be starved by later arrivals.
+a single dispatcher thread flushes pending requests into ``batch_fn``
+when either (a) ``max_batch`` requests are pending, or (b) the pending
+request with the EARLIEST deadline has exhausted its budget — so a lone
+robot never waits longer than its class's deadline, and a busy fleet
+always ships full batches.
+
+Ordering is **earliest-deadline-first** (serving/slo.py): every request
+carries an SLO class whose ``deadline_ms`` budget sets its absolute
+deadline at enqueue, and a flush takes the pending requests whose
+deadlines expire soonest. With a single class every deadline is
+enqueue-time + constant, so EDF degrades to exactly the FIFO the
+pre-SLO batcher shipped — no client is starved by later arrivals of its
+own class; a later arrival of a TIGHTER class overtakes by design.
+
+Overload is handled by shedding, not by queue collapse: with a
+``max_queue`` bound, an arrival into a full queue evicts the
+lowest-priority pending request (latest deadline breaks ties; the
+arrival itself is evicted if IT is lowest), failing its Future with
+``RequestShed`` and counting the shed per class — graceful degradation
+the fleet artifact can measure. A request whose deadline is already
+past at enqueue (e.g. a router hop consumed its whole budget) is shed
+immediately: counted, never dispatched, never occupying a bucket slot.
 """
 
 from __future__ import annotations
 
-import collections
+import contextlib
+import heapq
+import itertools
 import threading
 import time
 from concurrent.futures import Future
 from typing import Any, Callable, Optional, Sequence
 
+from tensor2robot_tpu.serving.slo import RequestShed, SLOClass
 from tensor2robot_tpu.serving.stats import ServingStats
 from tensor2robot_tpu.utils import profiling
 
 
 class _Request:
-  __slots__ = ("item", "future", "enqueued_at", "deadline")
+  __slots__ = ("item", "future", "enqueued_at", "deadline", "flush_at",
+               "slo", "shed")
 
-  def __init__(self, item: Any, deadline_s: float):
+  def __init__(self, item: Any, slo: SLOClass,
+               deadline_at: Optional[float], margin_s: float):
     self.item = item
     self.future: Future = Future()
     self.enqueued_at = time.perf_counter()
-    self.deadline = self.enqueued_at + deadline_s
+    # `deadline` is the CLIENT's latency budget (expiry/shed basis);
+    # `flush_at` is when the dispatcher must ship a partial batch so
+    # the answer lands INSIDE that budget — deadline minus the
+    # dispatch margin (the flush's own cost). Without the margin a
+    # lone request waits out its whole budget and then pays the flush
+    # on top, putting p99 structurally ABOVE the class budget at light
+    # load.
+    self.deadline = (self.enqueued_at + slo.deadline_ms / 1e3
+                     if deadline_at is None else deadline_at)
+    self.flush_at = max(self.enqueued_at, self.deadline - margin_s)
+    self.slo = slo
+    self.shed = False  # lazy heap deletion marker
 
 
 class MicroBatcher:
   """Batches concurrent ``submit`` calls into ``batch_fn`` flushes.
 
   Args:
-    batch_fn: callable taking the list of pending items (FIFO order)
+    batch_fn: callable taking the list of pending items (EDF order)
       and returning one result per item, same order. Runs on the
       dispatcher thread; an exception fails every request in the flush
       (never the batcher itself).
     max_batch: flush immediately once this many requests are pending.
-    deadline_ms: flush a partial batch once the oldest pending request
-      has waited this long — the latency budget a lone client pays.
-    stats: optional ServingStats; flush/occupancy/latency counters are
-      recorded when given. `bucket_for` (e.g. BucketLadder.bucket_for)
-      maps a flush size to the compiled batch slots it occupies for the
-      occupancy/waste counters; identity when absent.
+    deadline_ms: budget of the DEFAULT class — the latency budget a
+      class-less submit pays (back-compat: the pre-SLO constructor
+      signature keeps working and behaves identically).
+    stats: optional ServingStats; flush/occupancy/latency/shed counters
+      are recorded when given. `bucket_for` (e.g.
+      BucketLadder.bucket_for) maps a flush size to the compiled batch
+      slots it occupies for the occupancy/waste counters; identity when
+      absent.
+    max_queue: pending-queue bound (admission control). None =
+      unbounded, the pre-SLO behavior. With a bound, an arrival into a
+      full queue sheds the lowest-priority pending request
+      (lowest SLOClass.priority; latest deadline breaks ties).
   """
 
   def __init__(self, batch_fn: Callable[[Sequence[Any]], Sequence[Any]],
                max_batch: int = 16, deadline_ms: float = 5.0,
                stats: Optional[ServingStats] = None,
-               bucket_for: Optional[Callable[[int], int]] = None):
+               bucket_for: Optional[Callable[[int], int]] = None,
+               max_queue: Optional[int] = None,
+               dispatch_margin_ms: float = 0.0):
+    """See class docstring. `dispatch_margin_ms` budgets the flush's own
+    cost: a partial batch ships `margin` BEFORE its head's deadline, so
+    a class's p99 can actually sit inside its budget (set it to a
+    comfortable bound on one flush; 0 keeps the legacy flush-AT-deadline
+    behavior)."""
     if max_batch < 1:
       raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     if deadline_ms < 0:
       raise ValueError(f"deadline_ms must be >= 0, got {deadline_ms}")
+    if max_queue is not None and max_queue < 1:
+      raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+    if dispatch_margin_ms < 0:
+      raise ValueError(
+          f"dispatch_margin_ms must be >= 0, got {dispatch_margin_ms}")
     self._batch_fn = batch_fn
     self._max_batch = max_batch
-    self._deadline_s = deadline_ms / 1e3
+    self._margin_s = dispatch_margin_ms / 1e3
+    self._default_slo = SLOClass("default", 0, deadline_ms)
     self._stats = stats
     self._bucket_for = bucket_for or (lambda n: n)
-    self._queue: collections.deque = collections.deque()
+    self._max_queue = max_queue
+    # Min-heap of (deadline, seq, request); shed entries stay in the
+    # heap with request.shed=True and are skipped on pop (lazy
+    # deletion), _live tracks the real pending count.
+    self._heap: list = []
+    self._live = 0
+    self._in_flight = 0
+    self._seq = itertools.count()
     self._cond = threading.Condition()
     self._running = False
     self._thread: Optional[threading.Thread] = None
+    self._release = threading.Event()  # hold_flushes gate; normally set
+    self._release.set()
+    # Test-only observability (the zero-slack no-busy-spin regression
+    # test): how many times the dispatcher loop body ran. A spinning
+    # dispatcher shows unbounded growth while idle.
+    self._dispatch_iterations = 0
 
   # -- lifecycle -----------------------------------------------------------
 
@@ -97,25 +161,128 @@ class MicroBatcher:
 
   # -- client side ---------------------------------------------------------
 
-  def submit(self, item: Any) -> Future:
-    """Enqueues one item; the Future resolves to its batch_fn result."""
-    request = _Request(item, self._deadline_s)
+  @property
+  def max_batch(self) -> int:
+    return self._max_batch
+
+  @property
+  def max_queue(self) -> Optional[int]:
+    return self._max_queue
+
+  def use_stats(self, stats: Optional[ServingStats]) -> None:
+    """Swaps the stats sink (between measurement phases, while idle):
+    records are cheap reads of this attribute, so a swap is an atomic
+    pointer store — the fleet bench re-points all replicas per sweep
+    point rather than rebuilding batchers (which would recompile)."""
+    self._stats = stats
+
+  def pending(self) -> int:
+    """Pending + in-flight request count — the router's load signal."""
+    with self._cond:
+      return self._live + self._in_flight
+
+  @contextlib.contextmanager
+  def hold_flushes(self):
+    """Blocks dispatch (not admission) until exit: requests queue and
+    shed per the EDF/priority rules, but none are POPPED for a flush
+    while held (a flush already past the gate when the hold starts
+    just completes). Makes offered-load-vs-capacity behavior
+    DETERMINISTIC for overload tests and the fleet bench's burst
+    phase — the shed composition becomes a pure function of the
+    arrival sequence and the queue bound, not of how fast this host
+    happens to drain."""
+    self._release.clear()
+    try:
+      yield self
+    finally:
+      self._release.set()
+      with self._cond:
+        self._cond.notify_all()
+
+  def submit(self, item: Any, slo: Optional[SLOClass] = None,
+             deadline_at: Optional[float] = None) -> Future:
+    """Enqueues one item; the Future resolves to its batch_fn result.
+
+    Args:
+      item: opaque payload handed to batch_fn.
+      slo: the request's SLO class; None uses the default class built
+        from the constructor's deadline_ms (priority 0).
+      deadline_at: absolute deadline (time.perf_counter() basis) for
+        requests whose budget started at an upstream hop (the router's
+        ingress clock); overrides the class budget. A deadline already
+        in the past sheds the request immediately.
+    """
+    slo = slo or self._default_slo
+    request = _Request(item, slo, deadline_at, self._margin_s)
+    # Expired at enqueue: the budget was consumed before the request
+    # ever reached this queue (negative class budget, or an upstream
+    # hop ate it). Shed immediately — counted, never dispatched, and
+    # never even enqueued, so an expired flood cannot wake the
+    # dispatcher into a shed-purge spin. The lifecycle check still
+    # applies first: a stopped batcher must raise, not dress the
+    # caller's bug up as ordinary load shedding.
+    if request.deadline < request.enqueued_at:
+      with self._cond:
+        if not self._running:
+          raise RuntimeError("MicroBatcher is not running; call start().")
+      if self._stats is not None:
+        self._stats.record_request(slo.name)
+      self._shed(request, "expired")
+      return request.future
     with self._cond:
       if not self._running:
         raise RuntimeError("MicroBatcher is not running; call start().")
-      self._queue.append(request)
-      # Wake the dispatcher only when its state actually changes: the
-      # FIRST item arms the deadline timer (the dispatcher may be in an
-      # untimed wait), and reaching max_batch triggers an immediate
-      # flush. Intermediate arrivals ride the already-armed timed wait —
-      # on a busy fleet this cuts dispatcher wakeups from one per
-      # request to two per flush, which is most of the batching win on
-      # a GIL-bound host.
-      if len(self._queue) == 1 or len(self._queue) >= self._max_batch:
-        self._cond.notify()
+      victim = None
+      if self._max_queue is not None and self._live >= self._max_queue:
+        victim = self._pick_victim_locked(request)
+      if victim is not request:
+        head_flush_at = self._head_flush_at_locked()
+        heapq.heappush(self._heap,
+                       (request.flush_at, next(self._seq), request))
+        self._live += 1
+        # Wake the dispatcher only when its state actually changes: the
+        # first pending item (or a new EARLIEST deadline) re-arms the
+        # timed wait, and reaching max_batch triggers an immediate
+        # flush. Other arrivals ride the already-armed wait — on a busy
+        # fleet this cuts dispatcher wakeups from one per request to
+        # about two per flush, most of the batching win on a GIL-bound
+        # host.
+        if (head_flush_at is None or request.flush_at < head_flush_at
+            or self._live >= self._max_batch):
+          self._cond.notify()
     if self._stats is not None:
-      self._stats.record_request()
+      self._stats.record_request(slo.name)
+    if victim is not None:
+      self._shed(victim, "capacity")
     return request.future
+
+  def _pick_victim_locked(self, incoming: _Request) -> Optional[_Request]:
+    """Lowest-priority pending request (latest deadline breaks ties),
+    the incoming request included; None if nothing can be evicted (all
+    pending entries already shed — then the queue isn't really full)."""
+    victim = incoming
+    for _, _, request in self._heap:
+      if request.shed:
+        continue
+      if (request.slo.priority, -request.deadline) < (
+          victim.slo.priority, -victim.deadline):
+        victim = request
+    if victim is not incoming:
+      victim.shed = True
+      self._live -= 1
+    return victim
+
+  def _head_flush_at_locked(self) -> Optional[float]:
+    """Earliest live flush time; purges shed entries off the heap top."""
+    while self._heap and self._heap[0][2].shed:
+      heapq.heappop(self._heap)
+    return self._heap[0][0] if self._heap else None
+
+  def _shed(self, request: _Request, reason: str) -> None:
+    if self._stats is not None:
+      self._stats.record_shed(request.slo.name, reason)
+    if request.future.set_running_or_notify_cancel():
+      request.future.set_exception(RequestShed(request.slo.name, reason))
 
   # -- dispatcher ----------------------------------------------------------
 
@@ -135,25 +302,50 @@ class MicroBatcher:
               request.future.set_exception(e)
             except Exception:
               pass
+      finally:
+        with self._cond:
+          self._in_flight -= len(batch)
 
   def _next_batch(self):
     """Blocks until a flush is due; returns (requests, deadline_expired).
 
     (None, _) signals shutdown with an empty queue — on stop() the
     queue is drained (every accepted Future resolves) before exit.
+
+    No-busy-spin invariant: every pass either returns a batch, or waits
+    with a STRICTLY positive timeout (now < head deadline on that
+    branch), or waits untimed on an empty queue — a zero-slack deadline
+    therefore flushes immediately rather than re-arming a zero-length
+    wait in a loop.
     """
     with self._cond:
       while True:
-        if self._queue:
+        self._dispatch_iterations += 1
+        if not self._release.is_set() and self._running:
+          # hold_flushes active: nothing is popped while held. The
+          # timed wait covers the (benign) race of a release landing
+          # between this check and the wait. stop() OVERRIDES the hold
+          # (the `and self._running`): drain must always complete, so
+          # a stop racing a held burst flushes instead of deadlocking
+          # the join.
+          self._cond.wait(timeout=0.05)
+          continue
+        head = self._head_flush_at_locked()
+        if head is not None:
           now = time.perf_counter()
-          oldest = self._queue[0].deadline
-          if (len(self._queue) >= self._max_batch or now >= oldest
+          if (self._live >= self._max_batch or now >= head
               or not self._running):
-            n = min(len(self._queue), self._max_batch)
-            batch = [self._queue.popleft() for _ in range(n)]
-            expired = now >= oldest and n < self._max_batch
+            n = min(self._live, self._max_batch)
+            batch = []
+            while len(batch) < n:
+              _, _, request = heapq.heappop(self._heap)
+              if not request.shed:
+                batch.append(request)
+            self._live -= n
+            self._in_flight += n
+            expired = now >= head and n < self._max_batch
             return batch, expired
-          self._cond.wait(timeout=max(0.0, oldest - now))
+          self._cond.wait(timeout=head - now)
         elif not self._running:
           return None, False
         else:
@@ -179,10 +371,11 @@ class MicroBatcher:
     for request, result in zip(batch, results):
       request.future.set_result(result)
       if self._stats is not None:
-        self._stats.record_latency_ms((done - request.enqueued_at) * 1e3)
+        self._stats.record_latency_ms(
+            (done - request.enqueued_at) * 1e3, request.slo.name)
     if self._stats is not None:
       with self._cond:
-        depth_after = len(self._queue)
+        depth_after = self._live
       self._stats.record_flush(
           len(batch), self._bucket_for(len(batch)), depth_after,
           deadline_expired)

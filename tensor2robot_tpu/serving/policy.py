@@ -42,7 +42,14 @@ class CEMFleetPolicy:
   def __init__(self, predictor, action_size: int = 4,
                num_samples: int = 64, num_elites: int = 6,
                iterations: int = 3, seed: int = 0,
-               ladder: Optional[BucketLadder] = None):
+               ladder: Optional[BucketLadder] = None,
+               device=None):
+    """See class docstring. `device` pins this policy's executables and
+    inputs to ONE jax.Device — the fleet router's replica placement
+    (serving/router.py): each mesh device gets its own policy whose
+    ladder compiles exactly once per bucket PER DEVICE, and request
+    batches are device_put onto that replica before dispatch. None
+    keeps the default placement (single-chip behavior, unchanged)."""
     self._predictor = predictor
     self._action_size = action_size
     self._num_samples = num_samples
@@ -50,6 +57,12 @@ class CEMFleetPolicy:
     self._iterations = iterations
     self._seed = seed
     self.ladder = ladder or BucketLadder()
+    self.device = device
+    # (id -> (variables, placed)) single-digit cache: the live params
+    # plus a rollout candidate sharing this replica's executables. The
+    # stored variables ref pins the id (no reuse-after-GC aliasing);
+    # re-placement happens once per hot reload, never per request.
+    self._placed = {}
     self._executables = {}
     # bucket -> number of compilations; the serving invariant tests
     # assert every value stays exactly 1 for the life of the policy.
@@ -59,6 +72,7 @@ class CEMFleetPolicy:
     # not stall fleet-wide behind it.
     self._compile_lock = threading.Lock()
     self._seed_lock = threading.Lock()
+    self._place_lock = threading.Lock()
     self._next_seed = 0
 
   @property
@@ -73,7 +87,13 @@ class CEMFleetPolicy:
     return np.arange(start, start + n, dtype=np.uint32)
 
   def __call__(self, images: Sequence[np.ndarray],
-               seeds: Optional[Sequence[int]] = None) -> np.ndarray:
+               seeds: Optional[Sequence[int]] = None, *,
+               variables=None) -> np.ndarray:
+    """Control step for `images`. `variables` overrides the predictor's
+    live params THROUGH THE SAME compiled executables (params are an
+    argument, never baked in) — the rollout controller's shadow path
+    scores a candidate checkpoint on this replica's device without
+    adding a single entry to the compile ledger."""
     batch = np.stack([np.asarray(image) for image in images])
     n = batch.shape[0]
     seeds = (self.assign_seeds(n) if seeds is None
@@ -81,16 +101,53 @@ class CEMFleetPolicy:
     if seeds.shape != (n,):
       raise ValueError(f"need {n} seeds, got shape {seeds.shape}")
     try:
-      fn, variables = self._predictor.device_fn()
+      fn, live_variables = self._predictor.device_fn()
     except NotImplementedError:
+      if variables is not None:
+        raise ValueError(
+            "variables override requires the predictor's device path "
+            "(the host fallback scores through predictor.predict, whose "
+            "params cannot be swapped per call).")
       return self._host_call(batch, seeds)
+    variables = self._place(
+        live_variables if variables is None else variables)
     padded, bucket = self.ladder.pad_batch(batch)
     padded_seeds, _ = self.ladder.pad_batch(seeds)
     compiled = self._executable_for(bucket, fn, variables, padded,
                                     padded_seeds)
-    actions = compiled(variables, jnp.asarray(padded),
-                       jnp.asarray(padded_seeds))
+    actions = compiled(variables, self._put(padded),
+                       self._put(padded_seeds))
     return np.asarray(actions)[:n]
+
+  # -- device placement ----------------------------------------------------
+
+  def _put(self, array):
+    if self.device is None:
+      return jnp.asarray(array)
+    return jax.device_put(array, self.device)
+
+  def _place(self, variables):
+    """Device-placed view of a variables pytree, cached per identity.
+
+    Without a pinned device this is a no-op (jit moves host trees under
+    the default placement exactly as before). With one, the tree is
+    device_put ONCE per distinct params object: the live params after
+    each hot reload, plus at most a rollout candidate — so a replica
+    never re-uploads weights per request, and a param refresh costs one
+    transfer, zero compiles.
+    """
+    if self.device is None:
+      return variables
+    key = id(variables)
+    with self._place_lock:
+      entry = self._placed.get(key)
+      if entry is not None and entry[0] is variables:
+        return entry[1]
+      if len(self._placed) >= 4:  # live + candidate + their priors
+        self._placed.clear()
+      placed = jax.device_put(variables, self.device)
+      self._placed[key] = (variables, placed)
+      return placed
 
   # -- compiled path -------------------------------------------------------
 
@@ -122,7 +179,7 @@ class CEMFleetPolicy:
       compiled = self._executables.get(bucket)
       if compiled is None:
         lowered = jax.jit(self._build_control(fn)).lower(
-            variables, jnp.asarray(padded), jnp.asarray(padded_seeds))
+            variables, self._put(padded), self._put(padded_seeds))
         compiled = lowered.compile()
         self._executables[bucket] = compiled
         self.compile_counts[bucket] = (

@@ -1,0 +1,548 @@
+"""Live checkpoint rollout: shadow → canary → promote, with rollback.
+
+The missing tier between "the learner exports a checkpoint"
+(hooks/async_export_hook.py publishes versioned dirs) and "millions of
+users get its actions": cutting a fleet over to unvalidated params is
+how a bad checkpoint becomes a fleet-wide outage. The
+`RolloutController` instead walks each candidate through:
+
+1. **shadow** — the candidate is loaded next to the serving params on a
+   designated replica and a configurable fraction of live traffic is
+   *mirrored* to it (clients still get the serving answer). Mirrored
+   pairs are compared: action agreement (L2 distance), latency delta,
+   and the **Q-score delta under the serving params** — the serving
+   Q-function is the semantics oracle, so "the candidate's actions
+   score at least as well as ours" is a checkpoint-independent bar.
+2. **canary** — bars passed, a small fraction of live traffic is now
+   *served by* the candidate while the same Q/latency accounting runs
+   against concurrently-sampled live-served requests.
+3. **promote** — canary bars passed too: the predictor's variables are
+   hot-swapped (`AbstractPredictor.set_variables`), which every replica
+   picks up at its next flush — one device_put per replica, ZERO
+   recompiles (params are executable *arguments*; the hot-reload ledger
+   test pins this).
+
+A candidate failing the bars at either stage is **auto-rolled-back**:
+discarded with an event in the timeline, serving params untouched. The
+whole timeline (shadow_start / canary_start / promote / auto_rollback
+with their metrics) is what the fleet artifact commits.
+
+The shadow replica SHARES a live replica's compiled bucket executables
+(policy.py's `variables=` override) — evaluating a candidate adds zero
+entries to the compile ledger, and its device-time cost lands on one
+replica, which the router's least-loaded dispatch then routes around.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.serving.batcher import MicroBatcher
+from tensor2robot_tpu.serving.router import FleetRouter
+from tensor2robot_tpu.serving.slo import SLOClass
+
+_log = logging.getLogger(__name__)
+
+
+class ExportWatcher:
+  """Finds new candidate params in the async-export hook's output dir.
+
+  Pull: ``poll()`` lists the export root's versioned dirs
+  (export_utils.list_export_versions — the layout export_and_gc
+  publishes) and loads the newest unseen version's variables npz
+  (native_export_generator layout). Push: ``notify(export_dir, step)``
+  is shaped for `AsyncExportHook(on_export=...)` so a co-resident
+  trainer skips the poll latency. Either way the controller receives
+  ``(version, variables)``.
+  """
+
+  def __init__(self, export_root: str,
+               load_fn: Optional[Callable[[str], dict]] = None):
+    self._export_root = export_root
+    self._load_fn = load_fn or self._load_native
+    self._seen = -1
+    self._pushed: "queue.Queue" = queue.Queue()
+
+  @staticmethod
+  def _load_native(export_dir: str) -> dict:
+    from tensor2robot_tpu.export import variables_io
+    from tensor2robot_tpu.export.native_export_generator import (
+        VARIABLES_NPZ)
+    return variables_io.load_variables(
+        os.path.join(export_dir, VARIABLES_NPZ))
+
+  def notify(self, export_dir: str, step: int) -> None:
+    """Push entry (the AsyncExportHook on_export signature)."""
+    self._pushed.put((int(step), export_dir))
+
+  def poll(self):
+    """Returns (version, variables) for the newest unseen export, else
+    None. Pushed notifications win over directory listing; a load
+    failure (export mid-publish, half-written npz) is logged and
+    retried on the next poll rather than poisoning the controller."""
+    candidate = None
+    while True:  # drain pushes, keep the newest
+      try:
+        step, export_dir = self._pushed.get_nowait()
+      except queue.Empty:
+        break
+      if candidate is None or step > candidate[0]:
+        candidate = (step, export_dir)
+    if candidate is None:
+      from tensor2robot_tpu.export import export_utils
+      versions = export_utils.list_export_versions(self._export_root)
+      newest = versions[-1] if versions else None
+      if newest is not None and newest > self._seen:
+        candidate = (newest,
+                     os.path.join(self._export_root, str(newest)))
+    if candidate is None or candidate[0] <= self._seen:
+      return None
+    version, export_dir = candidate
+    try:
+      variables = self._load_fn(export_dir)
+    except Exception:
+      _log.exception("candidate %s unreadable; will retry", export_dir)
+      return None
+    self._seen = version
+    return version, variables
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutConfig:
+  """Canary bars and traffic fractions for the rollout state machine.
+
+  The q bar is relative: mean(Q_serving(image, candidate_action) -
+  Q_serving(image, live_action)) must stay above -max_q_regression.
+  Equal-or-better candidates pass at any traffic mix; a regressed
+  checkpoint (whose argmax actions score poorly under the serving
+  oracle) fails in shadow before a single client saw it.
+  """
+
+  mirror_fraction: float = 0.25   # of live traffic mirrored in shadow
+  canary_fraction: float = 0.10   # of live traffic SERVED by the canary
+  min_shadow_samples: int = 24    # compared pairs before the shadow bar
+  min_canary_samples: int = 12    # scored canary answers before promote
+  max_q_regression: float = 0.05  # mean q-delta floor (serving-Q units)
+  max_latency_ratio: float = 5.0  # shadow/live median latency ceiling
+  seed: int = 0                   # mirror/canary sampling stream
+
+
+class _PairSlot:
+  """Collects the (live, shadow) action pair for one mirrored request."""
+
+  __slots__ = ("image", "live", "shadow", "live_ms", "shadow_ms", "lock")
+
+  def __init__(self, image):
+    self.image = image
+    self.live = self.shadow = None
+    self.live_ms = self.shadow_ms = None
+    self.lock = threading.Lock()
+
+
+class RolloutController:
+  """Shadow/canary checkpoint rollout over a FleetRouter.
+
+  The client front door during a rollout: ``submit`` / ``act`` route
+  through the live fleet exactly like the router's, plus the mirroring
+  or canary routing the current phase calls for. `offer_candidate`
+  starts an evaluation (the watcher's finds are offered automatically
+  when `watcher` is given and `start()` has been called).
+
+  Args:
+    router: the live fleet.
+    predictor: the SHARED predictor serving the fleet; promotion calls
+      its ``set_variables`` (hot-swap, zero recompiles).
+    config: bars and fractions.
+    q_fn: ``(images list, actions list) -> (n,) scores`` under the
+      CURRENT serving params; defaults to predictor.predict's
+      ``q_predicted`` head. Evaluated on the controller's worker
+      thread, never on a replica dispatcher.
+    watcher: optional ExportWatcher polled by the worker thread.
+  """
+
+  def __init__(self, router: FleetRouter, predictor,
+               config: Optional[RolloutConfig] = None,
+               q_fn: Optional[Callable] = None,
+               watcher: Optional[ExportWatcher] = None,
+               poll_s: float = 0.2):
+    self._router = router
+    self._predictor = predictor
+    self._config = config or RolloutConfig()
+    self._q_fn = q_fn or self._default_q_fn
+    self._watcher = watcher
+    self._poll_s = poll_s
+    self._rng = np.random.default_rng(self._config.seed)
+    self._rng_lock = threading.Lock()
+    self._lock = threading.Lock()
+    self._state = "serving"
+    self._candidate_version = None
+    self._candidate_variables = None
+    self._shadow_batcher: Optional[MicroBatcher] = None
+    self._work: "queue.Queue" = queue.Queue()
+    self._worker: Optional[threading.Thread] = None
+    self._running = False
+    self._started_at = time.perf_counter()
+    self.events: List[dict] = []
+    self._reset_accumulators()
+
+  # -- lifecycle -----------------------------------------------------------
+
+  def start(self) -> "RolloutController":
+    with self._lock:
+      if self._running:
+        return self
+      self._running = True
+    self._worker = threading.Thread(
+        target=self._run, name="rollout-controller", daemon=True)
+    self._worker.start()
+    return self
+
+  def stop(self) -> None:
+    with self._lock:
+      if not self._running:
+        return
+      self._running = False
+    self._work.put(None)
+    if self._worker is not None:
+      self._worker.join()
+      self._worker = None
+    self._teardown_shadow()
+
+  def __enter__(self) -> "RolloutController":
+    return self.start()
+
+  def __exit__(self, *exc_info) -> None:
+    self.stop()
+
+  # -- client API ----------------------------------------------------------
+
+  def submit(self, image, slo: Optional[SLOClass] = None) -> Future:
+    """Routes one frame; mirrors or canaries it per the current phase.
+
+    Both phases compare PAIRED on the same (image, seed): shadow pairs
+    a live-served answer with a candidate mirror; canary pairs a
+    candidate-SERVED answer (returned to the client) with a live
+    mirror. Pairing is what makes the q-delta bar sharp — an
+    equal-weights candidate scores delta exactly 0 instead of
+    image-sampling noise.
+    """
+    state = self._state  # racy read is fine: phases change rarely and
+    # a request misrouted by one transition is just one more/fewer
+    # sample — the accumulators are guarded where it matters.
+    seed = self._router.assign_seed()
+    if state == "canary" and self._draw() < self._config.canary_fraction:
+      future = self._shadow_submit(image, seed, slo=slo)
+      if future is not None:
+        # Canary-served requests are REAL client traffic: account them
+        # in the fleet's per-class stats (request + completion latency)
+        # so the artifact's p99 doesn't silently exclude exactly the
+        # traffic a rollout perturbs. (The shadow queue is unbounded —
+        # canary traffic cannot shed; the canary fraction is small and
+        # the phase brief by construction.) The live MIRROR below is
+        # scoring-only duplicate work, so it rides the default class:
+        # never preempting real traffic, never inflating the client
+        # class's request counts.
+        if slo is not None:
+          self._router.stats.record_request(slo.name)
+          t0 = time.perf_counter()
+
+          def _account(f, _name=slo.name, _t0=t0):
+            if not f.cancelled() and f.exception() is None:
+              self._router.stats.record_latency_ms(
+                  (time.perf_counter() - _t0) * 1e3, _name)
+
+          future.add_done_callback(_account)
+        # The mirror's class: BELOW every real priority (sheds first,
+        # never evicts client traffic) with the client's own budget as
+        # its deadline — a class-less mirror would ride the 5ms default
+        # class, whose flush_at collapses to "now" under the fleet's
+        # dispatch margin and EDF-overtakes real traffic mid-rollout.
+        mirror_slo = SLOClass(
+            "rollout_mirror", priority=-1,
+            deadline_ms=slo.deadline_ms if slo is not None else 100.0)
+        live_mirror = self._router.submit(image, slo=mirror_slo,
+                                          seed=seed)
+        self._pair(image, live_mirror, future)
+        return future
+      # Shadow torn down between the state read and the submit (a
+      # rollback raced us): fall through to the live path.
+    future = self._router.submit(image, slo=slo, seed=seed)
+    if state == "shadow" and self._draw() < self._config.mirror_fraction:
+      shadow_future = self._shadow_submit(image, seed)
+      if shadow_future is not None:
+        self._pair(image, future, shadow_future)
+    return future
+
+  def act(self, image, slo: Optional[SLOClass] = None,
+          timeout: Optional[float] = None) -> np.ndarray:
+    return self.submit(image, slo=slo).result(timeout)
+
+  def offer_candidate(self, version, variables) -> bool:
+    """Starts evaluating a candidate; False if one is already in
+    flight (the watcher re-offers on a later poll)."""
+    with self._lock:
+      if self._state != "serving":
+        return False
+      self._state = "shadow"
+      self._candidate_version = version
+      self._candidate_variables = variables
+      self._reset_accumulators()
+      replica = self._router.replicas[-1]
+      self._shadow_batcher = MicroBatcher(
+          lambda items, _replica=replica: self._shadow_flush(
+              _replica, items),
+          max_batch=replica.batcher.max_batch,
+          deadline_ms=5.0).start()
+    self._record("shadow_start", version=version)
+    return True
+
+  # -- status / artifact ---------------------------------------------------
+
+  @property
+  def state(self) -> str:
+    return self._state
+
+  def timeline(self) -> List[dict]:
+    with self._lock:
+      return [dict(event) for event in self.events]
+
+  # -- internals -----------------------------------------------------------
+
+  def _default_q_fn(self, images, actions):
+    outputs = self._predictor.predict({
+        "image": np.stack([np.asarray(i) for i in images]),
+        "action": np.stack([np.asarray(a) for a in actions])})
+    return np.asarray(outputs["q_predicted"])
+
+  def _draw(self) -> float:
+    with self._rng_lock:
+      return float(self._rng.random())
+
+  def _reset_accumulators(self) -> None:
+    self._pairs_done = 0
+    self._agreement = []
+    self._q_live = []
+    self._q_shadow = []
+    self._lat_live_ms = []
+    self._lat_shadow_ms = []
+
+  def _shadow_submit(self, image, seed,
+                     slo: Optional[SLOClass] = None) -> Optional[Future]:
+    batcher = self._shadow_batcher
+    if batcher is None:
+      return None
+    try:
+      return batcher.submit((np.asarray(image), int(seed)), slo=slo)
+    except RuntimeError:  # stopped between the check and the submit
+      return None
+
+  def _shadow_flush(self, replica, items):
+    images = [item[0] for item in items]
+    seeds = np.asarray([item[1] for item in items], np.uint32)
+    variables = self._candidate_variables
+    if variables is None:
+      # Torn down with requests still queued (a promote/rollback raced
+      # a canary submit; stop() drains through here). Serve them with
+      # the LIVE params instead of failing the clients: after a
+      # promote the live params ARE the candidate, and after a
+      # rollback the live answer is the correct one. Mirror-phase
+      # pairs that land here just compare live-vs-live (q delta 0) —
+      # at most one flush's worth, and the stage already ended.
+      return list(replica.policy(images, seeds))
+    return list(replica.policy(images, seeds, variables=variables))
+
+  def _pair(self, image, live_future: Future,
+            shadow_future: Future) -> None:
+    slot = _PairSlot(image)
+    t0 = time.perf_counter()
+
+    def finish(which, future):
+      try:
+        action = future.result()
+      except Exception:
+        return  # shed/failed leg: drop the pair
+      ms = (time.perf_counter() - t0) * 1e3
+      with slot.lock:
+        setattr(slot, which, np.asarray(action))
+        setattr(slot, which + "_ms", ms)
+        complete = slot.live is not None and slot.shadow is not None
+      if complete:
+        self._work.put(("pair", slot))
+
+    live_future.add_done_callback(lambda f: finish("live", f))
+    shadow_future.add_done_callback(lambda f: finish("shadow", f))
+
+  def _run(self) -> None:
+    while True:
+      try:
+        item = self._work.get(timeout=self._poll_s)
+      except queue.Empty:
+        item = "tick"
+      if item is None:
+        return
+      try:
+        if item == "tick":
+          self._tick()
+        else:
+          _, payload = item
+          self._consume_pair(payload)
+      except Exception:
+        _log.exception("rollout worker step failed; continuing")
+
+  def _tick(self) -> None:
+    if self._watcher is None or self._state != "serving":
+      return
+    found = self._watcher.poll()
+    if found is not None:
+      self.offer_candidate(*found)
+
+  def _consume_pair(self, slot: _PairSlot) -> None:
+    state = self._state
+    if state not in ("shadow", "canary"):
+      return
+    # q under the SERVING params (the oracle): candidate actions must
+    # score at least as well as the live answers for the same frames.
+    scores = self._q_fn([slot.image, slot.image],
+                        [slot.live, slot.shadow])
+    with self._lock:
+      if self._state != state:
+        return  # a transition raced this pair; its stage is over
+      self._pairs_done += 1
+      self._agreement.append(
+          float(np.linalg.norm(slot.live - slot.shadow)))
+      self._q_live.append(float(scores[0]))
+      self._q_shadow.append(float(scores[1]))
+      self._lat_live_ms.append(slot.live_ms)
+      self._lat_shadow_ms.append(slot.shadow_ms)
+      threshold = (self._config.min_shadow_samples if state == "shadow"
+                   else self._config.min_canary_samples)
+      decide = self._pairs_done >= threshold
+    if decide:
+      if state == "shadow":
+        self._decide_shadow()
+      else:
+        self._decide_canary()
+
+  @staticmethod
+  def _median(values):
+    return float(np.median(values)) if values else None
+
+  def _shadow_metrics(self) -> dict:
+    q_delta = (float(np.mean(self._q_shadow) - np.mean(self._q_live))
+               if self._q_live else None)
+    live_ms = self._median(self._lat_live_ms)
+    shadow_ms = self._median(self._lat_shadow_ms)
+    return {
+        "pairs": self._pairs_done,
+        "action_agreement_l2_mean": round(
+            float(np.mean(self._agreement)), 5) if self._agreement
+        else None,
+        "q_delta_mean": round(q_delta, 5) if q_delta is not None
+        else None,
+        "latency_live_p50_ms": round(live_ms, 3) if live_ms else None,
+        "latency_shadow_p50_ms": round(shadow_ms, 3) if shadow_ms
+        else None,
+    }
+
+  def _decide_shadow(self) -> None:
+    with self._lock:
+      if self._state != "shadow":
+        return
+      metrics = self._shadow_metrics()
+      # Bar on the RAW mean, not the display-rounded metrics field —
+      # the canary stage compares raw, and the two stages must enforce
+      # the same bar.
+      raw_q_delta = (float(np.mean(self._q_shadow) -
+                           np.mean(self._q_live))
+                     if self._q_live else None)
+      q_ok = (raw_q_delta is not None and
+              raw_q_delta >= -self._config.max_q_regression)
+      live_ms = self._median(self._lat_live_ms)
+      shadow_ms = self._median(self._lat_shadow_ms)
+      latency_ok = (not live_ms or not shadow_ms or
+                    shadow_ms / max(live_ms, 1e-9)
+                    <= self._config.max_latency_ratio)
+      version = self._candidate_version
+    # Event BEFORE the state flip: callers poll `state` to learn a
+    # cycle finished, so the timeline must already carry its terminal
+    # event when `state` reads "serving" (the flip is the publication
+    # point; recording after it is a read-your-writes race).
+    if q_ok and latency_ok:
+      self._record("canary_start", version=version, **metrics)
+      with self._lock:
+        if self._state != "shadow":
+          return
+        self._state = "canary"
+        self._reset_accumulators()  # canary pairs judged on their own
+    else:
+      self._record("auto_rollback", version=version, stage="shadow",
+                   q_bar_passed=q_ok, latency_bar_passed=latency_ok,
+                   **metrics)
+      with self._lock:
+        stale_batcher = self._rollback_locked()
+      if stale_batcher is not None:
+        stale_batcher.stop()
+
+  def _decide_canary(self) -> None:
+    with self._lock:
+      if self._state != "canary":
+        return
+      q_delta = float(np.mean(self._q_shadow) - np.mean(self._q_live))
+      metrics = dict(self._shadow_metrics(),
+                     canary_pairs=self._pairs_done)
+      version = self._candidate_version
+      promote = q_delta >= -self._config.max_q_regression
+      variables = self._candidate_variables if promote else None
+    if promote:
+      # set_variables outside the lock: it device-puts the tree and
+      # must not block submit()'s state reads. The swap is atomic at
+      # the predictor (GIL pointer swap), replicas pick it up at their
+      # next flush — zero recompiles by the hot-reload contract. The
+      # candidate's version rides along so restore()'s newest-wins
+      # check can't later overwrite the promotion with an older
+      # on-disk checkpoint.
+      self._predictor.set_variables(variables, version=version)
+      self._record("promote", version=version, **metrics)
+    else:
+      self._record("auto_rollback", version=version, stage="canary",
+                   **metrics)
+    # Terminal event recorded; NOW publish the state flip (see
+    # _decide_shadow) and tear the shadow down outside the lock.
+    with self._lock:
+      stale_batcher = self._rollback_locked()
+    if stale_batcher is not None:
+      stale_batcher.stop()
+
+  def _rollback_locked(self) -> Optional[MicroBatcher]:
+    """Caller holds the lock: discard the candidate (serving params
+    untouched) and hand back the shadow batcher — the CALLER stops it
+    after releasing the lock (stop joins the shadow dispatcher thread,
+    whose in-flight flush may be blocked recording into our state)."""
+    self._state = "serving"
+    self._candidate_version = None
+    self._candidate_variables = None
+    batcher, self._shadow_batcher = self._shadow_batcher, None
+    return batcher
+
+  def _teardown_shadow(self) -> None:
+    with self._lock:
+      batcher, self._shadow_batcher = self._shadow_batcher, None
+    if batcher is not None:
+      batcher.stop()
+
+  def _record(self, event: str, **fields) -> None:
+    entry = {"event": event,
+             "t_s": round(time.perf_counter() - self._started_at, 3)}
+    entry.update(fields)
+    with self._lock:
+      self.events.append(entry)
+    _log.info("rollout %s: %s", event, fields)

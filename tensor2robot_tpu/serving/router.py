@@ -1,0 +1,227 @@
+"""Least-loaded router: the bucket ladder replicated over every device.
+
+One `FleetServer` keeps ONE chip busy for up to `max_batch` clients;
+fleet traffic needs the sebulba split (Podracer, PAPERS.md): replicated
+inference executables fed by a host-side router. This module is that
+layer — each mesh device (parallel/mesh.mesh_devices enumeration) gets
+its own *replica*: a `CEMFleetPolicy` pinned to the device (its ladder
+compiles exactly one executable per bucket PER DEVICE, the compile
+ledger the fleet artifact asserts) behind its own SLO-aware
+`MicroBatcher`, and the router dispatches each request to the replica
+with the shortest queue (pending + in-flight — joining the shortest
+line, not round-robin, so one slow flush doesn't back up the fleet).
+
+Per-request determinism survives routing: seeds are assigned at the
+router's front door from one monotonic counter, and a request's action
+depends on (image, seed) only (policy.py's fold_in contract) — which
+replica served it is unobservable in the action, so the single-replica
+`FleetServer` remains the semantics oracle for the whole fleet
+(PARITY round-11 note).
+
+Hot reload reaches every replica through the predictor: each flush
+reads `predictor.device_fn()`, so a promotion's `set_variables` swap
+(serving/rollout.py) is visible fleet-wide at the next flush — one
+device_put per replica, zero recompiles.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import numpy as np
+
+from tensor2robot_tpu.serving.batcher import MicroBatcher
+from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+from tensor2robot_tpu.serving.slo import SLOClass
+from tensor2robot_tpu.serving.stats import ServingStats
+
+
+class PolicyReplica:
+  """One device's slice of the fleet: pinned policy + its own batcher."""
+
+  def __init__(self, policy: CEMFleetPolicy, max_batch: int,
+               deadline_ms: float, stats: ServingStats,
+               max_queue: Optional[int], dispatch_margin_ms: float):
+    self.policy = policy
+    self.device = policy.device
+    self.batcher = MicroBatcher(
+        self._flush, max_batch=max_batch, deadline_ms=deadline_ms,
+        stats=stats, bucket_for=policy.ladder.bucket_for,
+        max_queue=max_queue, dispatch_margin_ms=dispatch_margin_ms)
+
+  def _flush(self, items):
+    images = [item[0] for item in items]
+    seeds = np.asarray([item[1] for item in items], np.uint32)
+    return list(self.policy(images, seeds))
+
+  def warmup(self, make_image) -> None:
+    """Compiles the full ladder on this replica's device (server
+    startup, before traffic): the measured path then never compiles."""
+    for bucket in self.policy.ladder.sizes:
+      self.policy([make_image(i) for i in range(bucket)],
+                  np.arange(bucket, dtype=np.uint32))
+
+
+class FleetRouter:
+  """Routes fleet traffic to per-device policy replicas, least-loaded.
+
+  Args:
+    predictor: shared predictor (one set of live params; replicas place
+      them per device). Must provide device_fn() — replication of a
+      host-only predictor would serialize on the host anyway.
+    devices: the replica devices. Pass `parallel.mesh.mesh_devices(mesh)`
+      to replicate over a training mesh, or any explicit device list;
+      None uses jax.devices() (every visible device).
+    max_batch: per-replica flush threshold (defaults to the ladder top
+      rung, same rule as FleetServer).
+    deadline_ms: default-class budget for class-less submits.
+    max_queue: per-replica admission bound; offered load beyond it
+      sheds lowest-priority-first (serving/slo.py). None = unbounded.
+    stats: shared ServingStats across ALL replicas (one is created if
+      not given) — per-class latency/shed counters aggregate fleet-wide.
+    cem / ladder kwargs: forwarded to each replica's CEMFleetPolicy.
+  """
+
+  def __init__(self, predictor, devices: Optional[Sequence] = None,
+               action_size: int = 4, num_samples: int = 64,
+               num_elites: int = 6, iterations: int = 3, seed: int = 0,
+               ladder_sizes: Optional[Sequence[int]] = None,
+               max_batch: Optional[int] = None, deadline_ms: float = 5.0,
+               max_queue: Optional[int] = None,
+               dispatch_margin_ms: float = 0.0,
+               stats: Optional[ServingStats] = None,
+               metric_writer=None):
+    import jax
+
+    from tensor2robot_tpu.serving.bucketing import BucketLadder
+
+    devices = list(jax.devices() if devices is None else devices)
+    if not devices:
+      raise ValueError("FleetRouter needs at least one device.")
+    self.stats = stats or ServingStats()
+    self._metric_writer = metric_writer
+    self._metric_step = 0
+    self._predictor = predictor
+    self._seed_lock = threading.Lock()
+    self._next_seed = 0
+    self._rr = itertools.count()  # least-loaded tie-break rotation
+    self.replicas = []
+    for device in devices:
+      ladder = (BucketLadder(ladder_sizes) if ladder_sizes is not None
+                else BucketLadder())
+      policy = CEMFleetPolicy(
+          predictor, action_size=action_size, num_samples=num_samples,
+          num_elites=num_elites, iterations=iterations, seed=seed,
+          ladder=ladder, device=device)
+      replica_max_batch = (ladder.max_batch if max_batch is None
+                           else max_batch)
+      if replica_max_batch > ladder.max_batch:
+        raise ValueError(
+            f"max_batch {replica_max_batch} exceeds ladder top rung "
+            f"{ladder.max_batch}")
+      self.replicas.append(PolicyReplica(
+          policy, replica_max_batch, deadline_ms, self.stats, max_queue,
+          dispatch_margin_ms))
+
+  # -- lifecycle -----------------------------------------------------------
+
+  def start(self) -> "FleetRouter":
+    for replica in self.replicas:
+      replica.batcher.start()
+    return self
+
+  def stop(self) -> None:
+    for replica in self.replicas:
+      replica.batcher.stop()
+
+  def __enter__(self) -> "FleetRouter":
+    return self.start()
+
+  def __exit__(self, *exc_info) -> None:
+    self.stop()
+
+  def warmup(self, make_image) -> None:
+    """Compiles every bucket on every replica before traffic (the
+    fleet bench's precompile phase; the ledger then proves the measured
+    sweep never compiled)."""
+    for replica in self.replicas:
+      replica.warmup(make_image)
+
+  def use_stats(self, stats: ServingStats) -> None:
+    """Swaps the shared stats sink (between sweep points, while idle):
+    per-point artifact accounting without rebuilding replicas — a
+    rebuild would recompile the whole ladder, which is exactly what the
+    ledger forbids mid-run."""
+    self.stats = stats
+    for replica in self.replicas:
+      replica.batcher.use_stats(stats)
+
+  # -- client API ----------------------------------------------------------
+
+  def assign_seed(self) -> int:
+    with self._seed_lock:
+      seed = self._next_seed
+      self._next_seed += 1
+    return seed
+
+  def submit(self, image, slo: Optional[SLOClass] = None,
+             seed: Optional[int] = None,
+             deadline_at: Optional[float] = None) -> Future:
+    """Enqueues one frame on the least-loaded replica.
+
+    The request's absolute deadline is stamped HERE (router ingress),
+    so replica queueing cannot silently extend a class budget: if the
+    chosen replica's queue already ate the budget, the replica sheds it
+    as expired (counted) instead of serving a dead answer.
+    """
+    if slo is not None and deadline_at is None:
+      deadline_at = time.perf_counter() + slo.deadline_ms / 1e3
+    seed = self.assign_seed() if seed is None else int(seed)
+    # Least-loaded with a ROTATING tie-break: bare min() resolves every
+    # tie to replica 0, hot-spotting one device whenever queues are
+    # equal (an idle fleet, or all-full under overload — where it also
+    # concentrates every eviction on one replica's queue).
+    offset = next(self._rr)
+    n = len(self.replicas)
+    replica = min(
+        ((r.batcher.pending(), (i - offset) % n, r)
+         for i, r in enumerate(self.replicas)),
+        key=lambda entry: entry[:2])[2]
+    return replica.batcher.submit(
+        (np.asarray(image), seed), slo=slo, deadline_at=deadline_at)
+
+  def act(self, image, slo: Optional[SLOClass] = None,
+          timeout: Optional[float] = None) -> np.ndarray:
+    """Blocking control step through the routed fleet."""
+    return self.submit(image, slo=slo).result(timeout)
+
+  # -- observability -------------------------------------------------------
+
+  def compile_ledger(self) -> dict:
+    """{device_label: {bucket: compile_count}} over every replica — the
+    fleet invariant is every inner value == 1 (one executable per
+    bucket PER DEVICE, recompiled never)."""
+    return {
+        str(replica.device): dict(replica.policy.compile_counts)
+        for replica in self.replicas}
+
+  def snapshot(self) -> dict:
+    """Aggregated stats + the per-device executable ledger + depths."""
+    out = self.stats.snapshot()
+    out["replicas"] = len(self.replicas)
+    out["compile_ledger"] = self.compile_ledger()
+    out["replica_pending"] = [replica.batcher.pending()
+                              for replica in self.replicas]
+    return out
+
+  def write_metrics(self, step: Optional[int] = None) -> None:
+    if self._metric_writer is None:
+      return
+    if step is None:
+      step = self._metric_step
+      self._metric_step += 1
+    self.stats.write_to(self._metric_writer, step)

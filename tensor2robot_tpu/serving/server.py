@@ -68,14 +68,18 @@ class FleetServer:
 
   # -- client API ----------------------------------------------------------
 
-  def submit(self, image) -> Future:
-    """Enqueues one camera frame; resolves to its (action_size,) action."""
+  def submit(self, image, slo=None) -> Future:
+    """Enqueues one camera frame; resolves to its (action_size,) action.
+    `slo` (serving/slo.py) overrides the default deadline class — the
+    single-replica server honors the same EDF/shedding contract the
+    routed fleet does, which is what keeps it the semantics oracle."""
     seed = int(self._policy.assign_seeds(1)[0])
-    return self._batcher.submit((np.asarray(image), seed))
+    return self._batcher.submit((np.asarray(image), seed), slo=slo)
 
-  def act(self, image, timeout: Optional[float] = None) -> np.ndarray:
+  def act(self, image, timeout: Optional[float] = None,
+          slo=None) -> np.ndarray:
     """Blocking control step: the closed-loop client call."""
-    return self.submit(image).result(timeout)
+    return self.submit(image, slo=slo).result(timeout)
 
   # -- internals / observability ------------------------------------------
 

@@ -1,0 +1,81 @@
+"""Priority / SLO classes for fleet serving.
+
+A fleet serving millions of users is never uniformly loaded; what keeps
+degradation graceful instead of a tail-latency collapse is that every
+request carries a *class* — a deadline budget plus a priority — and the
+micro-batcher spends capacity by class:
+
+- admission is **earliest-deadline-first** (EDF): within the pending
+  queue, the request whose deadline expires soonest flushes first, so a
+  tight-budget interactive frame is never stuck behind a long-budget
+  batch probe that happened to arrive earlier;
+- shedding is **lowest-priority-first**: when offered load exceeds
+  capacity (the queue bound), the victim is the lowest-priority pending
+  request (latest deadline breaks ties), and every shed is accounted
+  per class in ``ServingStats`` — the fleet artifact's shed-rate fields
+  are how "graceful" becomes a measured claim;
+- a request whose deadline is already unmeetable at enqueue is shed
+  *immediately* (counted, never dispatched): spending a bucket slot on
+  an answer the client has already abandoned starves requests that can
+  still meet their budget.
+
+The Gemma-on-TPU serving comparison (PAPERS.md) frames the cost/p99
+tradeoff this module makes explicit: the class ladder is the knob that
+trades padding waste and shed rate against per-class p99.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOClass:
+  """One service class: a latency budget and a shed priority.
+
+  Attributes:
+    name: stable class key (stats, artifacts, metric_writer scalars).
+    priority: higher = more important; shedding removes the LOWEST
+      priority pending request first.
+    deadline_ms: per-request latency budget from enqueue. This is both
+      the micro-batcher's flush trigger (a partial batch ships once the
+      EDF-head's budget expires) and the class's p99 bar in the fleet
+      artifact. Zero means "flush me immediately" (still admitted);
+      negative means the deadline has already passed at enqueue and the
+      request is shed on arrival.
+  """
+
+  name: str
+  priority: int
+  deadline_ms: float
+
+
+# The default three-tier ladder the fleet bench sweeps. Budgets are
+# host-scale (CPU smoke) numbers — a real deployment tunes them to its
+# chip; the STRUCTURE (interactive ≫ batch priority, batch ≫ interactive
+# budget) is the contract.
+INTERACTIVE = SLOClass("interactive", priority=2, deadline_ms=30.0)
+STANDARD = SLOClass("standard", priority=1, deadline_ms=100.0)
+BATCH = SLOClass("batch", priority=0, deadline_ms=500.0)
+DEFAULT_CLASSES: Tuple[SLOClass, ...] = (INTERACTIVE, STANDARD, BATCH)
+
+
+class RequestShed(RuntimeError):
+  """Raised into a request's Future when the batcher sheds it.
+
+  Carries the class name and the reason ("expired" — the deadline was
+  already past at enqueue; "capacity" — offered load exceeded the queue
+  bound and this request was the lowest-priority victim). Clients treat
+  it as an explicit, *accounted* overload signal, distinct from a
+  server fault: the action is to retry later or degrade, not to crash.
+  """
+
+  def __init__(self, class_name: str, reason: str,
+               detail: Optional[str] = None):
+    self.class_name = class_name
+    self.reason = reason
+    message = f"request shed ({reason}) for class {class_name!r}"
+    if detail:
+      message += f": {detail}"
+    super().__init__(message)

@@ -34,6 +34,7 @@ class TinyQPredictor(AbstractPredictor):
     w = rng.standard_normal(
         (image_size * image_size * 3, action_size)).astype(np.float32)
     self._variables = {"params": {"w": jnp.asarray(0.05 * w)}}
+    self._version = 0
     self._predict = jax.jit(self._fn)
 
   @staticmethod
@@ -49,6 +50,33 @@ class TinyQPredictor(AbstractPredictor):
     """The analytic optimum CEM should find for `image`."""
     flat = np.asarray(image, np.float32).reshape(1, -1)
     return np.tanh(flat @ np.asarray(self._variables["params"]["w"]))[0]
+
+  def make_candidate_variables(self, scale: float = 1.0,
+                               jitter: float = 0.0,
+                               seed: int = 1) -> Dict:
+    """A rollout-candidate params tree for shadow/canary tests.
+
+    ``scale=1.0, jitter=0.0`` is a healthy candidate (bit-equal Q —
+    the promotion happy path must pass its canary bars). A large
+    ``jitter`` (fresh random weights mixed in) is the injected
+    regression: its argmax actions score far below the serving
+    optimum under the serving Q, so the controller's q-delta bar
+    must auto-roll it back.
+    """
+    w = np.asarray(self._variables["params"]["w"], np.float32)
+    if jitter:
+      rng = np.random.default_rng(seed)
+      w = w + jitter * rng.standard_normal(w.shape).astype(np.float32)
+    return {"params": {"w": jnp.asarray(scale * w)}}
+
+  def set_variables(self, variables, version=None) -> None:
+    """See AbstractPredictor.set_variables (promotion hot-swap)."""
+    if np.shape(variables["params"]["w"]) != np.shape(
+        self._variables["params"]["w"]):
+      raise ValueError("hot-swap shape mismatch")
+    self._variables = {
+        "params": {"w": jnp.asarray(variables["params"]["w"])}}
+    self._version = self._next_swap_version(version)
 
   def make_image(self, seed: int) -> np.ndarray:
     rng = np.random.default_rng(seed)
@@ -82,4 +110,4 @@ class TinyQPredictor(AbstractPredictor):
 
   @property
   def model_version(self) -> int:
-    return 0
+    return self._version

@@ -3,7 +3,13 @@
 The fleet numbers the artifact schema carries (docs/ARTIFACTS.md
 serving row): per-request latency p50/p99, queue depth at flush, batch
 occupancy (real requests / compiled bucket slots), and padding waste.
-Everything is plain host floats, so a snapshot can go straight into
+Since round 11 every counter is additionally kept PER SLO CLASS
+(serving/slo.py): class-keyed latency histograms plus shed counters
+split by reason ("expired" at enqueue vs "capacity" overload), because
+the fleet's graceful-degradation claim is exactly "batch sheds before
+standard, standard before interactive, and interactive p99 holds its
+budget" — a global p99 cannot carry that. Everything is plain host
+floats, so a snapshot can go straight into
 ``utils/metric_writer.MetricWriter.write_scalars`` or a JSON artifact.
 """
 
@@ -60,6 +66,18 @@ class LatencyHistogram:
     }
 
 
+class _ClassStats:
+  """Per-SLO-class counters (guarded by the owning ServingStats lock)."""
+
+  __slots__ = ("requests", "shed_expired", "shed_capacity", "latency")
+
+  def __init__(self):
+    self.requests = 0
+    self.shed_expired = 0
+    self.shed_capacity = 0
+    self.latency = LatencyHistogram()
+
+
 class ServingStats:
   """Thread-safe counters for the micro-batching serving path."""
 
@@ -72,10 +90,37 @@ class ServingStats:
     self._padded_slots = 0     # sum of compiled bucket sizes over flushes
     self._deadline_flushes = 0  # flushed by deadline, not by a full batch
     self._queue_depth_sum = 0   # queue depth left behind at flush time
+    self._per_class: Dict[str, _ClassStats] = {}
 
-  def record_request(self) -> None:
+  def _class(self, class_name: Optional[str]) -> Optional[_ClassStats]:
+    """Lazily creates the class bucket; caller holds the lock."""
+    if class_name is None:
+      return None
+    stats = self._per_class.get(class_name)
+    if stats is None:
+      stats = self._per_class[class_name] = _ClassStats()
+    return stats
+
+  def record_request(self, class_name: Optional[str] = None) -> None:
     with self._lock:
       self._requests += 1
+      cls = self._class(class_name)
+      if cls is not None:
+        cls.requests += 1
+
+  def record_shed(self, class_name: Optional[str], reason: str) -> None:
+    """One shed request: reason is "expired" (deadline already past at
+    enqueue) or "capacity" (queue bound exceeded, lowest-priority
+    victim). Sheds are counted on top of record_request — a shed
+    request was offered load too."""
+    with self._lock:
+      cls = self._class(class_name or "default")
+      if reason == "expired":
+        cls.shed_expired += 1
+      elif reason == "capacity":
+        cls.shed_capacity += 1
+      else:
+        raise ValueError(f"unknown shed reason {reason!r}")
 
   def record_flush(self, batch_size: int, bucket: int,
                    queue_depth_after: int, deadline_expired: bool) -> None:
@@ -87,11 +132,18 @@ class ServingStats:
       if deadline_expired:
         self._deadline_flushes += 1
 
-  def record_latency_ms(self, latency_ms: float) -> None:
+  def record_latency_ms(self, latency_ms: float,
+                        class_name: Optional[str] = None) -> None:
     self.latency.record(latency_ms)
+    if class_name is not None:
+      with self._lock:
+        hist = self._class(class_name).latency
+      hist.record(latency_ms)
 
   def snapshot(self) -> Dict[str, float]:
-    """One flat dict: counters + derived ratios + latency percentiles."""
+    """One dict: counters + derived ratios + latency percentiles, plus
+    a ``per_class`` sub-dict keyed by SLO class name (empty when no
+    class-tagged traffic was recorded)."""
     with self._lock:
       flushes = self._flushes
       out = {
@@ -109,14 +161,50 @@ class ServingStats:
           "mean_queue_depth_after_flush": round(
               self._queue_depth_sum / flushes, 3) if flushes else None,
       }
+      # Per-class entries are built while still holding the lock so
+      # sum(per_class shed) always equals shed_total within ONE
+      # snapshot, even with dispatcher threads recording concurrently.
+      # (Lock order ServingStats -> LatencyHistogram; no path takes
+      # the reverse order.)
+      per_class = {name: self._class_snapshot(cls)
+                   for name, cls in sorted(self._per_class.items())}
+      shed_total = sum(entry["shed"] for entry in per_class.values())
+    out["shed_total"] = shed_total
     for key, value in self.latency.summary().items():
       out["latency_" + key if not key.startswith("count") else
           "latency_samples"] = value
+    out["per_class"] = per_class
     return out
+
+  @staticmethod
+  def _class_snapshot(cls: _ClassStats) -> Dict[str, float]:
+    shed = cls.shed_expired + cls.shed_capacity
+    entry = {
+        "requests": cls.requests,
+        "shed": shed,
+        "shed_expired": cls.shed_expired,
+        "shed_capacity": cls.shed_capacity,
+        "shed_rate": round(shed / cls.requests, 4) if cls.requests else 0.0,
+    }
+    for key, value in cls.latency.summary().items():
+      entry["latency_" + key if not key.startswith("count") else
+            "latency_samples"] = value
+    return entry
 
   def write_to(self, metric_writer, step: int,
                prefix: str = "serving/") -> None:
-    """Routes the snapshot's numeric fields through a MetricWriter."""
-    scalars = {prefix + k: v for k, v in self.snapshot().items()
+    """Routes the snapshot's numeric fields through a MetricWriter.
+
+    Per-class fields flatten onto the existing schema as
+    ``{prefix}class/{name}/{field}`` — the same write_scalars call the
+    global counters use, so a dashboard keyed on the serving/ namespace
+    picks up class latency/shed series with no new plumbing.
+    """
+    snap = self.snapshot()
+    scalars = {prefix + k: v for k, v in snap.items()
                if isinstance(v, (int, float)) and v is not None}
+    for name, entry in snap.get("per_class", {}).items():
+      scalars.update({
+          f"{prefix}class/{name}/{k}": v for k, v in entry.items()
+          if isinstance(v, (int, float)) and v is not None})
     metric_writer.write_scalars(step, scalars)

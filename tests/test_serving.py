@@ -197,6 +197,288 @@ class TestMicroBatcher:
     assert snap["latency_p50_ms"] >= 18.0
 
 
+class TestSLOBatcher:
+  """ISSUE 10: EDF admission, priority shedding, and the deadline edge
+  cases (expired-at-enqueue sheds immediately; zero-slack deadlines
+  must not busy-spin the dispatcher)."""
+
+  def test_expired_at_enqueue_shed_immediately(self):
+    """A request whose deadline is already past when it reaches the
+    queue (an upstream hop ate the budget) is shed on arrival: counted
+    per class, NEVER dispatched, and the shed is visible to the client
+    as RequestShed."""
+    import time as time_mod
+
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+    from tensor2robot_tpu.serving.slo import RequestShed, SLOClass
+    from tensor2robot_tpu.serving.stats import ServingStats
+
+    dispatched = []
+    stats = ServingStats()
+    with MicroBatcher(lambda items: [dispatched.append(i) or i
+                                     for i in items],
+                      max_batch=4, deadline_ms=50.0,
+                      stats=stats) as batcher:
+      expired = batcher.submit(
+          "dead", slo=SLOClass("interactive", 2, 30.0),
+          deadline_at=time_mod.perf_counter() - 0.01)
+      with pytest.raises(RequestShed) as info:
+        expired.result(timeout=5)
+      assert info.value.reason == "expired"
+      assert info.value.class_name == "interactive"
+      # A negative class budget is the same case without deadline_at.
+      with pytest.raises(RequestShed):
+        batcher.submit("dead2",
+                       slo=SLOClass("stale", 0, -1.0)).result(timeout=5)
+      # The batcher still serves live traffic afterwards.
+      assert batcher.submit("alive").result(timeout=5) == "alive"
+    assert "dead" not in dispatched and "dead2" not in dispatched
+    snap = stats.snapshot()
+    assert snap["per_class"]["interactive"]["shed_expired"] == 1
+    assert snap["per_class"]["stale"]["shed_expired"] == 1
+    assert snap["shed_total"] == 2
+    # Shed requests were still offered load: counted as requests.
+    assert snap["per_class"]["interactive"]["requests"] == 1
+
+  def test_zero_slack_deadline_does_not_busy_spin(self):
+    """deadline_ms=0 means "flush me immediately" — it must flush (not
+    shed) and must not leave the dispatcher re-arming a zero-length
+    wait in a loop. Regression guard: the dispatcher's loop-iteration
+    counter stays bounded while the batcher sits idle after zero-slack
+    traffic."""
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+    from tensor2robot_tpu.serving.slo import SLOClass
+
+    zero = SLOClass("now", 1, 0.0)
+    with MicroBatcher(lambda items: list(items), max_batch=8,
+                      deadline_ms=10_000.0) as batcher:
+      for i in range(5):
+        assert batcher.submit(i, slo=zero).result(timeout=5) == i
+      settle = batcher._dispatch_iterations
+      time.sleep(0.25)  # idle window: a spinner racks up iterations
+      assert batcher._dispatch_iterations - settle <= 2, (
+          "dispatcher busy-spun while idle")
+      # Still responsive after the idle window.
+      assert batcher.submit(99, slo=zero).result(timeout=5) == 99
+
+  def test_expired_submit_on_stopped_batcher_raises(self):
+    """Lifecycle beats shedding: an expired-deadline submit on a
+    stopped (or never-started) batcher raises RuntimeError like any
+    other submit — a dead batcher must not dress the caller's bug up
+    as ordinary load shedding."""
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+    from tensor2robot_tpu.serving.slo import SLOClass
+
+    batcher = MicroBatcher(lambda items: list(items))
+    with pytest.raises(RuntimeError):
+      batcher.submit("x", slo=SLOClass("stale", 0, -1.0))
+
+  def test_stop_during_hold_flushes_drains(self):
+    """stop() overrides an active hold: the queued requests drain
+    instead of the join deadlocking behind the gate."""
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+
+    with MicroBatcher(lambda items: list(items), max_batch=4,
+                      deadline_ms=10_000.0) as batcher:
+      with batcher.hold_flushes():
+        futures = [batcher.submit(i) for i in range(3)]
+        batcher.stop()  # must drain despite the hold, not hang
+      assert [f.result(timeout=5) for f in futures] == [0, 1, 2]
+
+  def test_edf_tighter_class_overtakes(self):
+    """A later-arriving tighter-deadline request flushes before an
+    earlier lax one (EDF), while same-class traffic stays FIFO."""
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+    from tensor2robot_tpu.serving.slo import SLOClass
+
+    lax = SLOClass("lax", 0, 500.0)
+    tight = SLOClass("tight", 2, 10.0)
+    order = []
+
+    def batch_fn(items):
+      order.extend(items)
+      return list(items)
+
+    with MicroBatcher(batch_fn, max_batch=1,
+                      deadline_ms=500.0) as batcher:
+      futures = [batcher.submit(("lax", i), slo=lax) for i in range(2)]
+      futures.append(batcher.submit(("tight", 0), slo=tight))
+      for f in futures:
+        f.result(timeout=10)
+    assert order[0] == ("tight", 0), order
+    assert order[1:] == [("lax", 0), ("lax", 1)], order
+
+  def test_capacity_shed_lowest_priority_first(self):
+    """With the queue at its bound, an arrival evicts the LOWEST
+    priority pending request — high-priority traffic rides through an
+    overload while the batch tier sheds, with per-class accounting."""
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+    from tensor2robot_tpu.serving.slo import RequestShed, SLOClass
+    from tensor2robot_tpu.serving.stats import ServingStats
+
+    high = SLOClass("high", 2, 5_000.0)
+    low = SLOClass("low", 0, 5_000.0)
+    stats = ServingStats()
+    release = threading.Event()
+
+    def slow(items):
+      release.wait(10)
+      return list(items)
+
+    with MicroBatcher(slow, max_batch=1, deadline_ms=0.0, stats=stats,
+                      max_queue=2) as batcher:
+      blocker = batcher.submit("blocker")   # in flight, holds the loop
+      time.sleep(0.05)
+      low_fut = batcher.submit("low", slo=low)       # queued
+      high1 = batcher.submit("high1", slo=high)      # queued (full now)
+      high2 = batcher.submit("high2", slo=high)      # evicts "low"
+      with pytest.raises(RequestShed) as info:
+        low_fut.result(timeout=5)
+      assert info.value.reason == "capacity"
+      # An arrival that is ITSELF the lowest priority is the victim.
+      with pytest.raises(RequestShed):
+        batcher.submit("low2", slo=low).result(timeout=5)
+      release.set()
+      assert blocker.result(timeout=10) == "blocker"
+      assert high1.result(timeout=10) == "high1"
+      assert high2.result(timeout=10) == "high2"
+    snap = stats.snapshot()
+    assert snap["per_class"]["low"]["shed_capacity"] == 2
+    assert snap["per_class"]["high"]["shed"] == 0
+    assert snap["per_class"]["high"]["requests"] == 2
+
+  def test_per_class_stats_metric_writer_emission(self, tmp_path):
+    """ISSUE 10 satellite: class-keyed latency histograms and shed
+    counters flow through the EXISTING metric_writer schema as
+    serving/class/<name>/<field> scalars, alongside the global p50/p99."""
+    import json as json_mod
+
+    from tensor2robot_tpu.serving.stats import ServingStats
+    from tensor2robot_tpu.utils.metric_writer import MetricWriter
+
+    stats = ServingStats()
+    for latency in (5.0, 10.0, 15.0):
+      stats.record_request("interactive")
+      stats.record_latency_ms(latency, "interactive")
+    stats.record_request("batch")
+    stats.record_shed("batch", "capacity")
+    stats.record_request("batch")
+    stats.record_shed("batch", "expired")
+
+    snap = stats.snapshot()
+    assert snap["per_class"]["interactive"]["latency_p50_ms"] == 10.0
+    assert snap["per_class"]["interactive"]["shed"] == 0
+    assert snap["per_class"]["batch"]["shed_capacity"] == 1
+    assert snap["per_class"]["batch"]["shed_expired"] == 1
+    assert snap["per_class"]["batch"]["shed_rate"] == 1.0
+    assert snap["shed_total"] == 2
+
+    writer = MetricWriter(str(tmp_path))
+    stats.write_to(writer, step=7)
+    writer.close()
+    with open(tmp_path / "metrics.jsonl") as f:
+      record = json_mod.loads(f.readlines()[-1])
+    assert record["serving/class/interactive/latency_p50_ms"] == 10.0
+    assert record["serving/class/interactive/requests"] == 3
+    assert record["serving/class/batch/shed_capacity"] == 1
+    assert record["serving/class/batch/shed_expired"] == 1
+    assert record["serving/shed_total"] == 2
+    # The pre-existing global fields survive unchanged.
+    assert record["serving/requests"] == 5
+    assert "serving/latency_p50_ms" in record
+
+
+class TestHotReloadLedger:
+
+  def test_param_refresh_never_recompiles_bucket_executables(self):
+    """ISSUE 10 satellite: the RolloutController promotion path is
+    predictor.set_variables on a live CEMFleetPolicy — across >= 3
+    refreshes the compile ledger must be BIT-stable: same buckets, all
+    counts exactly 1, and the very same executable objects serving
+    (params are arguments, never baked in)."""
+    from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+    from tensor2robot_tpu.serving.smoke import TinyQPredictor
+
+    predictor = TinyQPredictor(image_size=8, action_size=4, seed=0)
+    original_w = np.array(predictor._variables["params"]["w"])
+    policy = CEMFleetPolicy(predictor, action_size=4, num_samples=32,
+                            num_elites=4, iterations=2, seed=0)
+    for n in (1, 2, 3, 8, 16):  # touches every ladder bucket
+      policy([predictor.make_image(i) for i in range(n)])
+    ledger_before = dict(policy.compile_counts)
+    executables_before = {bucket: id(executable) for bucket, executable
+                          in policy._executables.items()}
+    assert all(count == 1 for count in ledger_before.values())
+
+    for refresh in range(3):
+      predictor.set_variables(
+          predictor.make_candidate_variables(jitter=0.1,
+                                             seed=refresh + 1))
+      for n in (2, 5, 16):
+        actions = policy([predictor.make_image(10 * refresh + i)
+                          for i in range(n)])
+        assert actions.shape == (n, 4)
+      assert dict(policy.compile_counts) == ledger_before, (
+          f"refresh {refresh} changed the ledger")
+      assert {bucket: id(executable) for bucket, executable
+              in policy._executables.items()} == executables_before, (
+                  f"refresh {refresh} swapped an executable object")
+    assert predictor.model_version == 3
+    # The refreshed params actually serve: the action lands closer to
+    # the NEW weights' optimum than the original weights' (a stale
+    # variables cache would still answer the old one).
+    image = predictor.make_image(77)
+    action = policy([image])[0]
+    flat = np.asarray(image, np.float32).reshape(1, -1)
+    old_optimum = np.tanh(flat @ original_w)[0]
+    new_optimum = predictor.best_action(image)
+    assert (np.linalg.norm(action - new_optimum)
+            < np.linalg.norm(action - old_optimum))
+
+  def test_checkpoint_predictor_rejects_shape_or_dtype_drift(self):
+    """The promotion guard must fail a malformed candidate HERE, not
+    as an aval mismatch inside some replica's next AOT flush: both a
+    reshape and a dtype change are rejected; a well-formed swap with a
+    version lands."""
+    import jax
+
+    from tensor2robot_tpu.predictors.checkpoint_predictor import (
+        CheckpointPredictor)
+    from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+
+    predictor = CheckpointPredictor(
+        TinyQCriticModel(image_size=8, action_size=4))
+    predictor.init_randomly()
+    good = jax.tree_util.tree_map(np.asarray, predictor._variables)
+    wrong_dtype = jax.tree_util.tree_map(
+        lambda x: np.asarray(x, np.float64), good)
+    with pytest.raises(ValueError, match="dtype"):
+      predictor.set_variables(wrong_dtype)
+    wrong_shape = jax.tree_util.tree_map(
+        lambda x: np.concatenate([x, x], axis=0), good)
+    with pytest.raises(ValueError, match="shape"):
+      predictor.set_variables(wrong_shape)
+    predictor.set_variables(good, version=42)
+    assert predictor.model_version == 42
+
+  def test_set_variables_version_keeps_staleness_namespace(self):
+    """A promotion carries the candidate's export step: model_version
+    adopts it (so a restore() poll finding an OLDER on-disk checkpoint
+    cannot overwrite the promoted params), stays monotonic when the
+    passed version would regress, and falls back to +1 without one."""
+    from tensor2robot_tpu.serving.smoke import TinyQPredictor
+
+    predictor = TinyQPredictor(seed=0)
+    predictor.set_variables(predictor.make_candidate_variables(),
+                            version=250)
+    assert predictor.model_version == 250
+    predictor.set_variables(predictor.make_candidate_variables(),
+                            version=150)  # older step: clamp, not regress
+    assert predictor.model_version == 251
+    predictor.set_variables(predictor.make_candidate_variables())
+    assert predictor.model_version == 252
+
+
 @pytest.fixture(scope="module")
 def tiny_predictor():
   from tensor2robot_tpu.serving.smoke import TinyQPredictor
