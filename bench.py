@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 11
+ROUND = 12
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -62,14 +62,10 @@ HEADLINE_BATCH = 128
 ITERATIONS_PER_LOOP = 60
 
 # Chip peaks for mfu, keyed by substrings of device_kind.
-# v5e ("TPU v5 lite"): 197 TFLOP/s bf16 (public spec).
-_CHIP_PEAKS = {
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v4": 275e12,
-    "v6": 918e12,
-}
+# v5e ("TPU v5 lite"): 197 TFLOP/s bf16 (public spec). Owned by the
+# obs ledger since round 12 so every MFU estimate (headline, per-
+# executable attribution) reads one table.
+from tensor2robot_tpu.obs.ledger import CHIP_PEAKS as _CHIP_PEAKS
 
 # --- the derived A100 baseline -------------------------------------------
 # BASELINE.json's north star: beat the fork's 8xA100 tf.distribute+NCCL
@@ -1010,6 +1006,23 @@ def _bench_fleet_compact():
       rollout_min_shadow=8, rollout_min_canary=4)
 
 
+def _bench_obs_compact():
+  """Observability block for the bench detail (ISSUE 11).
+
+  The committed chipless artifact (OBS_r12.json) carries the full
+  protocol on the 8-virtual-device mesh, where estimated_mfu is
+  honestly null (no CPU peak model). This block is the
+  driver-refreshable real-chip counterpart: a reduced run of the same
+  three phases (fused replay attribution, host-loop stage spans,
+  routed serve window + injected breach) on the window's real devices,
+  where the per-executable estimated-MFU column becomes a measured
+  number against the chip's known peak. Same schema as the artifact.
+  """
+  from tensor2robot_tpu.obs.obs_bench import measure_obs
+  return measure_obs(replay_steps=40, host_steps=12,
+                     serve_duration_s=1.0)
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1166,6 +1179,11 @@ def main() -> None:
   except Exception as e:
     anakin_multichip = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    obs = _bench_obs_compact()
+  except Exception as e:
+    obs = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1225,6 +1243,7 @@ def main() -> None:
       "actor": actor,
       "anakin": anakin,
       "anakin_multichip": anakin_multichip,
+      "obs": obs,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1260,6 +1279,13 @@ def main() -> None:
           (anakin_multichip.get("scales") or [{}])[-1].get(
               "scaling_efficiency_vs_1dev")
           if len(anakin_multichip.get("scales") or []) > 1 else None),
+      # Obs sentinel (ISSUE 11): the fused replay executable's measured
+      # device-time share of its run window. Null-safe under error.
+      "obs_anakin_step_share": next(
+          (row.get("device_time_share")
+           for row in (obs.get("replay", {}).get("attribution", {})
+                       .get("executables") or [])
+           if row.get("name") == "anakin_step"), None),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,
   }))

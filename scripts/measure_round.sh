@@ -45,6 +45,14 @@
 #                                burst, shadow/canary rollout cycles,
 #                                per-device compile ledger; normally
 #                                builder-committed and skipped)
+#   OBS_r0N.json                 obs/obs_bench --smoke (CHIPLESS
+#                                backstop too — ISSUE 11: per-
+#                                executable device-time attribution
+#                                over the replay-smoke protocol, the
+#                                Chrome-trace stage coverage, and the
+#                                injected-SLO-breach flight-recorder
+#                                dump; normally builder-committed and
+#                                skipped)
 #   BENCH_DETAIL_r0N.json        bench.py (orchestrator; also emits the
 #                                compact line, saved to BENCH_builder_r0N.json)
 #   SERVING_r0N.json             bin/bench_serving single-robot + --fleet lines
@@ -160,6 +168,22 @@ else
   done
   run_stage "FLEET_${RTAG}.json" 1800 sh -c '
     python -m tensor2robot_tpu.serving.fleet_bench --smoke \
+      --out "$STAGE_TMP"'
+fi
+# Fourth chipless backstop (ISSUE 11): the observability protocol —
+# attribution over the replay smoke, stage-span trace, injected-breach
+# flight-recorder dump. Same tmp→mv atomicity and pytest deferral
+# rules (its attribution shares are timing measurements).
+if [ -s "OBS_${RTAG}.json" ]; then
+  log "skip OBS_${RTAG}.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring obs backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "OBS_${RTAG}.json" 1800 sh -c '
+    python -m tensor2robot_tpu.obs.obs_bench --smoke \
       --out "$STAGE_TMP"'
 fi
 while [ "$(date +%s)" -lt "$deadline" ]; do

@@ -79,6 +79,23 @@ import sys
 import tempfile
 
 
+def parse_profile(spec):
+  """'START,END' -> (start, end) optimizer-step window; None passthrough."""
+  if not spec:
+    return None
+  parts = spec.split(",")
+  if len(parts) != 2:
+    raise ValueError(f"--profile takes START,END steps, got {spec!r}")
+  try:
+    start, end = int(parts[0]), int(parts[1])
+  except ValueError:
+    raise ValueError(f"--profile takes integers, got {spec!r}")
+  if start < 0 or end <= start:
+    raise ValueError(
+        f"--profile needs 0 <= START < END, got {spec!r}")
+  return start, end
+
+
 def parse_mesh(spec: str):
   """'8' or '4,2' -> (dp, tp). '0' keeps the mode default mesh."""
   parts = spec.split(",")
@@ -105,7 +122,7 @@ def parse_mesh(spec: str):
 
 def build_config(smoke: bool, seed: int, device_resident: bool = False,
                  vector_actors: bool = False, anakin: bool = False,
-                 mesh=(0, 1)):
+                 mesh=(0, 1), profile_window=None):
   from tensor2robot_tpu.replay.loop import ReplayLoopConfig
   dp, tp = mesh
   if smoke:
@@ -118,7 +135,8 @@ def build_config(smoke: bool, seed: int, device_resident: bool = False,
     return ReplayLoopConfig(seed=seed, device_resident=device_resident,
                             vector_actors=vector_actors, anakin=anakin,
                             envs_per_collector=up(4), batch_size=up(32),
-                            capacity=up(512), mesh_dp=dp, mesh_tp=tp)
+                            capacity=up(512), mesh_dp=dp, mesh_tp=tp,
+                            profile_window=profile_window)
   return ReplayLoopConfig(
       image_size=64, batch_size=32, capacity=50_000, min_fill=2_000,
       num_buffer_shards=4, num_collectors=4, envs_per_collector=8,
@@ -127,17 +145,18 @@ def build_config(smoke: bool, seed: int, device_resident: bool = False,
       eval_batches=8, log_every=50, learning_rate=1e-4, seed=seed,
       device_resident=device_resident, megastep_inner=50,
       ingest_chunk=256, vector_actors=vector_actors, anakin=anakin,
-      anakin_inner=200, anakin_bank_scenes=4096, mesh_dp=dp, mesh_tp=tp)
+      anakin_inner=200, anakin_bank_scenes=4096, mesh_dp=dp, mesh_tp=tp,
+      profile_window=profile_window)
 
 
 def run(steps: int, smoke: bool, logdir: str, seed: int,
         device_resident: bool = False, learner_bench: bool = True,
         vector_actors: bool = False, actor_bench: bool = True,
         anakin: bool = False, anakin_bench: bool = True,
-        mesh=(0, 1)) -> dict:
+        mesh=(0, 1), profile_window=None) -> dict:
   from tensor2robot_tpu.replay.loop import ReplayTrainLoop
   config = build_config(smoke, seed, device_resident, vector_actors,
-                        anakin, mesh=mesh)
+                        anakin, mesh=mesh, profile_window=profile_window)
   model = None  # default: the flagship QTOptGraspingModel
   if smoke:
     # CI-scale critic (replay/smoke.py): the flagship's conv tower
@@ -240,6 +259,15 @@ def main(argv=None) -> None:
                            "(default: the mode's single-mesh default; "
                            "with --anakin this is the pod-scale "
                            "sharded configuration — ISSUE 7)")
+  parser.add_argument("--profile", default=None,
+                      help="START,END optimizer-step window for a "
+                           "jax.profiler device-trace capture into "
+                           "<logdir>/profile (the train ProfilerHook's "
+                           "windowed capture, now on every replay "
+                           "path; the window snaps outward to the "
+                           "loop's dispatch boundaries, and the "
+                           "guarded start_trace prevents a double "
+                           "capture when another window is active)")
   parser.add_argument("--logdir", default=None,
                       help="metric_writer logdir (default: a tempdir)")
   parser.add_argument("--seed", type=int, default=0)
@@ -247,6 +275,7 @@ def main(argv=None) -> None:
                       help="also write the JSON line to this file")
   args = parser.parse_args(argv)
   mesh = parse_mesh(args.mesh)
+  profile_window = parse_profile(args.profile)
   if args.smoke:
     n_devices = mesh[0] * mesh[1]
     if n_devices > 1:
@@ -282,7 +311,7 @@ def main(argv=None) -> None:
                 actor_bench=not args.no_actor_bench,
                 anakin=args.anakin,
                 anakin_bench=not args.no_anakin_bench,
-                mesh=mesh)
+                mesh=mesh, profile_window=profile_window)
   line = json.dumps(results)
   if args.out:
     with open(args.out, "w") as f:

@@ -1,0 +1,47 @@
+"""One observability spine for the production loop (ISSUE 11).
+
+Four layers, each usable alone, designed to compose:
+
+- ``trace``: host-side structured spans (thread-safe, nestable) that
+  double as ``jax.profiler.TraceAnnotation``s while a device trace is
+  active, exportable as one Chrome-trace/Perfetto JSON per run.
+- ``registry``: a process-wide typed metric registry (counters, gauges,
+  bounded histograms with p50/p99 snapshots) with one bridge flushing
+  snapshots through the existing ``utils.metric_writer.MetricWriter``
+  (JSONL + TensorBoard stay the dashboards).
+- ``ledger``: the compile-count dicts scattered through replay/ and
+  serving/ promoted to a first-class ``ExecutableLedger`` that joins
+  ``compiled.cost_analysis()`` FLOPs/bytes with dispatch counts and
+  measured wall time into per-executable device-time attribution.
+- ``flight_recorder``: a bounded in-memory ring of recent spans/events,
+  dumped atomically to ``<logdir>/flightrec-*.json`` on SLO breach,
+  rollout auto-rollback, or an unhandled loop-thread exception.
+
+The Podracer analysis (PAPERS.md, arXiv:2104.06272) and the pjit/TPUv4
+scaling study (arXiv:2204.06514) both justify their architectures with
+exactly this per-executable utilization accounting; the multi-host and
+bf16-CEM directions in ROADMAP.md will be measured through this layer.
+"""
+
+from tensor2robot_tpu.obs.flight_recorder import (FlightRecorder,
+                                                  get_recorder)
+from tensor2robot_tpu.obs.ledger import (ExecutableLedger,
+                                         check_compile_ledger,
+                                         peak_flops_for)
+from tensor2robot_tpu.obs.registry import MetricRegistry, get_registry
+from tensor2robot_tpu.obs.trace import (Tracer, get_tracer,
+                                        set_device_annotations, span)
+
+__all__ = [
+    "ExecutableLedger",
+    "FlightRecorder",
+    "MetricRegistry",
+    "Tracer",
+    "check_compile_ledger",
+    "get_recorder",
+    "get_registry",
+    "get_tracer",
+    "peak_flops_for",
+    "set_device_annotations",
+    "span",
+]

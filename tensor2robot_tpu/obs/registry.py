@@ -1,0 +1,195 @@
+"""Process-wide typed metric registry: counters, gauges, histograms.
+
+Before this module every subsystem kept its own scalar plumbing:
+``ServingStats`` counters, the replay loop's ad-hoc health dicts, the
+trainer's per-sync metric maps. The registry is the one namespace they
+all emit through; the EXISTING ``utils.metric_writer.MetricWriter``
+(JSONL + TensorBoard) stays the dashboard — ``flush_to`` is the single
+bridge, so a metric registered anywhere reaches both sinks with no new
+plumbing, and the JSONL records carry host/pid for the coming
+multi-host tier (stamped by MetricWriter itself).
+
+Types are enforced: asking for ``counter("x")`` after ``gauge("x")``
+raises instead of silently aliasing two semantics onto one name.
+Histograms are bounded reservoirs (newest ``max_samples`` kept) with
+nearest-rank p50/p99 snapshots — the same percentile convention
+``serving.stats.LatencyHistogram`` established.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+from typing import Dict, Iterable, Mapping, Optional
+
+
+def _nearest_rank(ordered, pct: float) -> float:
+  rank = min(len(ordered) - 1,
+             max(0, math.ceil(pct / 100.0 * len(ordered)) - 1))
+  return ordered[rank]
+
+
+class Counter:
+  """Monotonic process-lifetime count."""
+
+  __slots__ = ("name", "_value", "_lock")
+
+  def __init__(self, name: str):
+    self.name = name
+    self._value = 0
+    self._lock = threading.Lock()
+
+  def inc(self, n: int = 1) -> int:
+    with self._lock:
+      self._value += n
+      return self._value
+
+  @property
+  def value(self) -> int:
+    with self._lock:
+      return self._value
+
+
+class Gauge:
+  """Last-write-wins scalar."""
+
+  __slots__ = ("name", "_value", "_lock")
+
+  def __init__(self, name: str):
+    self.name = name
+    self._value: Optional[float] = None
+    self._lock = threading.Lock()
+
+  def set(self, value: float) -> None:
+    with self._lock:
+      self._value = float(value)
+
+  @property
+  def value(self) -> Optional[float]:
+    with self._lock:
+      return self._value
+
+
+class Histogram:
+  """Bounded reservoir (newest max_samples) with percentile snapshots."""
+
+  __slots__ = ("name", "_samples", "_count", "_lock")
+
+  def __init__(self, name: str, max_samples: int = 16384):
+    self.name = name
+    self._samples: collections.deque = collections.deque(maxlen=max_samples)
+    self._count = 0
+    self._lock = threading.Lock()
+
+  def record(self, value: float) -> None:
+    with self._lock:
+      self._samples.append(float(value))
+      self._count += 1
+
+  def snapshot(self, digits: int = 4) -> Dict[str, float]:
+    with self._lock:
+      samples = list(self._samples)
+      count = self._count
+    if not samples:
+      return {"count": 0}
+    ordered = sorted(samples)
+    return {
+        "count": count,
+        "p50": round(_nearest_rank(ordered, 50), digits),
+        "p90": round(_nearest_rank(ordered, 90), digits),
+        "p99": round(_nearest_rank(ordered, 99), digits),
+        "max": round(ordered[-1], digits),
+        "mean": round(sum(samples) / len(samples), digits),
+    }
+
+
+class MetricRegistry:
+  """Typed name → metric map with one MetricWriter bridge."""
+
+  def __init__(self):
+    self._metrics: Dict[str, object] = {}
+    self._lock = threading.Lock()
+
+  def _get(self, name: str, kind):
+    with self._lock:
+      metric = self._metrics.get(name)
+      if metric is None:
+        metric = self._metrics[name] = kind(name)
+      elif not isinstance(metric, kind):
+        raise TypeError(
+            f"metric {name!r} is a {type(metric).__name__}, not a "
+            f"{kind.__name__} — one name, one type")
+      return metric
+
+  def counter(self, name: str) -> Counter:
+    return self._get(name, Counter)
+
+  def gauge(self, name: str) -> Gauge:
+    return self._get(name, Gauge)
+
+  def histogram(self, name: str) -> Histogram:
+    return self._get(name, Histogram)
+
+  def set_gauges(self, scalars: Mapping[str, float]) -> None:
+    """Batch gauge update (the loops' per-sync health blocks)."""
+    for name, value in scalars.items():
+      if value is None:
+        continue
+      self.gauge(name).set(value)
+
+  def names(self) -> Iterable[str]:
+    with self._lock:
+      return sorted(self._metrics)
+
+  def snapshot(self, names: Optional[Iterable[str]] = None
+               ) -> Dict[str, float]:
+    """Flat scalar view: counters/gauges by name, histograms flattened
+    to ``name/p50`` ``name/p99`` ``name/mean`` ``name/count``.
+    ``names`` restricts to those metric names BEFORE any histogram
+    reservoir is sorted — flushing a handful of gauges must not pay
+    for every 16k-sample latency reservoir in the process."""
+    with self._lock:
+      metrics = dict(self._metrics)
+    if names is not None:
+      wanted = set(names)
+      metrics = {name: metric for name, metric in metrics.items()
+                 if name in wanted}
+    out: Dict[str, float] = {}
+    for name, metric in sorted(metrics.items()):
+      if isinstance(metric, Histogram):
+        for key, value in metric.snapshot().items():
+          out[f"{name}/{key}"] = value
+      else:
+        value = metric.value
+        if value is not None:
+          out[name] = value
+    return out
+
+  def flush_to(self, metric_writer, step: int,
+               names: Optional[Iterable[str]] = None,
+               prefix: str = "") -> None:
+    """THE bridge: one ``write_scalars`` call per flush.
+
+    ``names`` restricts the flush to those metric names (the loops pass
+    exactly the block they just updated, so their JSONL records keep
+    the pre-registry schema byte-for-byte); None flushes everything.
+    """
+    snap = self.snapshot(names=names)
+    scalars = {prefix + key: value for key, value in snap.items()
+               if isinstance(value, (int, float))}
+    if scalars:
+      metric_writer.write_scalars(step, scalars)
+
+
+_DEFAULT: Optional[MetricRegistry] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_registry() -> MetricRegistry:
+  """The process-wide registry every wired component emits through."""
+  global _DEFAULT
+  with _DEFAULT_LOCK:
+    if _DEFAULT is None:
+      _DEFAULT = MetricRegistry()
+    return _DEFAULT

@@ -1,0 +1,188 @@
+"""Host-side structured spans for the whole production loop.
+
+``span("replay/learn", **attrs)`` is a thread-safe, nestable context
+manager. Completed spans land in a bounded in-memory ring and are
+exportable as ONE Chrome-trace/Perfetto JSON file per run
+(``Tracer.export_chrome_trace``), so "where did the wall-clock go"
+is answerable for any run without a debugger attached.
+
+Span names are ``stage/detail`` — the first path segment is the loop
+stage (``act``, ``extend``, ``learn``, ``serve``, ``replay``), which
+``stage_counts()`` aggregates and the obs bench asserts coverage over.
+
+While a device trace is active (the guarded window in
+``utils.profiling``), every span ALSO enters a
+``jax.profiler.TraceAnnotation`` with the same name, so host spans line
+up against XLA device lanes in the same Perfetto view. Outside a trace
+window the annotation is skipped entirely — the hot-path cost of a span
+is two ``perf_counter`` reads and one deque append.
+
+Listeners (``add_listener``) receive every completed span dict — the
+flight recorder subscribes so the last N spans are always available for
+a post-mortem dump.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+_log = logging.getLogger(__name__)
+
+
+class Tracer:
+  """Bounded ring of completed spans + per-thread nesting state."""
+
+  def __init__(self, max_spans: int = 65536):
+    self._epoch = time.perf_counter()
+    self._spans: collections.deque = collections.deque(maxlen=max_spans)
+    self._total = 0
+    self._lock = threading.Lock()
+    self._local = threading.local()
+    self._listeners: List[Callable[[dict], None]] = []
+    # Toggled by utils.profiling's guarded start/stop_trace: spans only
+    # pay the TraceAnnotation cost while a device trace can see them.
+    self.annotate_devices = False
+
+  # -- recording -----------------------------------------------------------
+
+  def _stack(self) -> list:
+    stack = getattr(self._local, "stack", None)
+    if stack is None:
+      stack = self._local.stack = []
+    return stack
+
+  @contextlib.contextmanager
+  def span(self, name: str, **attrs):
+    """One nestable span; attrs must be JSON-serializable scalars."""
+    stack = self._stack()
+    parent = stack[-1] if stack else None
+    depth = len(stack)
+    stack.append(name)
+    annotation = None
+    if self.annotate_devices:
+      import jax
+      annotation = jax.profiler.TraceAnnotation(name)
+      annotation.__enter__()
+    start = time.perf_counter()
+    try:
+      yield
+    finally:
+      duration = time.perf_counter() - start
+      if annotation is not None:
+        annotation.__exit__(None, None, None)
+      stack.pop()
+      record = {
+          "name": name,
+          "ts_s": round(start - self._epoch, 6),
+          "dur_s": round(duration, 6),
+          "tid": threading.get_ident(),
+          "depth": depth,
+      }
+      if parent is not None:
+        record["parent"] = parent
+      if attrs:
+        record.update(attrs)
+      with self._lock:
+        self._spans.append(record)
+        self._total += 1
+      for listener in list(self._listeners):
+        try:
+          listener(record)
+        except Exception:  # diagnostics must never crash the path
+          _log.warning("span listener %r failed", listener,
+                       exc_info=True)
+
+  def add_listener(self, listener: Callable[[dict], None]) -> None:
+    """Registers a completed-span callback (e.g. the flight recorder)."""
+    with self._lock:
+      if listener not in self._listeners:
+        self._listeners.append(listener)
+
+  # -- readout -------------------------------------------------------------
+
+  def spans(self) -> List[dict]:
+    with self._lock:
+      return list(self._spans)
+
+  @property
+  def total_spans(self) -> int:
+    """Spans ever recorded (the ring may have dropped the oldest)."""
+    with self._lock:
+      return self._total
+
+  def stage_counts(self) -> Dict[str, int]:
+    """{first path segment of span name: count} over the retained ring."""
+    counts: Dict[str, int] = {}
+    for record in self.spans():
+      stage = record["name"].split("/", 1)[0]
+      counts[stage] = counts.get(stage, 0) + 1
+    return counts
+
+  def clear(self) -> None:
+    with self._lock:
+      self._spans.clear()
+      self._total = 0
+
+  def export_chrome_trace(self, path: str) -> str:
+    """Writes the retained spans as Chrome-trace JSON (atomic tmp→mv).
+
+    Loads directly in Perfetto / chrome://tracing; complete events
+    ("ph": "X") with microsecond timestamps relative to this tracer's
+    epoch, one row per Python thread.
+    """
+    retained = self.spans()
+    pid = os.getpid()
+    events = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": f"{socket.gethostname()}:{pid}"},
+    }]
+    for record in retained:
+      args = {key: value for key, value in record.items()
+              if key not in ("name", "ts_s", "dur_s", "tid")}
+      events.append({
+          "name": record["name"],
+          "ph": "X",
+          "ts": round(record["ts_s"] * 1e6, 3),
+          "dur": round(record["dur_s"] * 1e6, 3),
+          "pid": pid,
+          "tid": record["tid"],
+          "args": args,
+      })
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+      json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+_DEFAULT: Optional[Tracer] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+  """The process-wide tracer every wired component records into."""
+  global _DEFAULT
+  with _DEFAULT_LOCK:
+    if _DEFAULT is None:
+      _DEFAULT = Tracer()
+    return _DEFAULT
+
+
+def span(name: str, **attrs):
+  """``with obs.trace.span("learn/megastep", k=10): ...``"""
+  return get_tracer().span(name, **attrs)
+
+
+def set_device_annotations(enabled: bool) -> None:
+  """Flip TraceAnnotation emission on the process tracer (the guarded
+  profiler window in utils.profiling owns this flag)."""
+  get_tracer().annotate_devices = bool(enabled)

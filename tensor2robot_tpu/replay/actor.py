@@ -57,6 +57,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from tensor2robot_tpu.obs import flight_recorder as flight_lib
+from tensor2robot_tpu.obs import trace as trace_lib
 from tensor2robot_tpu.replay.ingest import TransitionQueue
 
 
@@ -142,6 +144,8 @@ class VectorActor:
         self.busy_seconds += time.perf_counter() - start
     except BaseException as e:  # noqa: BLE001 — surfaced via stop()
       self.errors.append(e)
+      flight_lib.get_recorder().trigger(
+          "actor_thread_exception", error=f"{type(e).__name__}: {e}")
 
   def step_once(self) -> None:
     """One batched control step: act → step → enqueue, all fleet-wide.
@@ -156,7 +160,8 @@ class VectorActor:
     n = env.num_envs
     scenes = env.images.copy()
     targets = env.targets.copy()
-    actions = np.asarray(self._policy(scenes))
+    with trace_lib.span("act/cem_policy", envs=n):
+      actions = np.asarray(self._policy(scenes))
     draw = self._explore_rng.random(n)
     uniform = self._explore_rng.uniform(
         -1.0, 1.0, actions.shape).astype(np.float32)

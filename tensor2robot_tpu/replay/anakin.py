@@ -82,6 +82,8 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from tensor2robot_tpu.obs import ledger as obs_ledger
+from tensor2robot_tpu.obs import trace as trace_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.replay.bellman import (TargetNetwork,
                                              make_bellman_targets_fn,
@@ -128,6 +130,7 @@ class AnakinLoop(TargetNetwork):
       scripted_fraction: float = 0.25,
       seed: int = 0,
       polyak_tau: Optional[float] = None,
+      ledger: Optional[obs_ledger.ExecutableLedger] = None,
   ):
     if inner_steps < 1 or train_every < 1 or inner_steps % train_every:
       raise ValueError(
@@ -190,6 +193,7 @@ class AnakinLoop(TargetNetwork):
     # of ROADMAP item 5 lands against this field).
     self.dtype = "float32"
     self.compile_counts: Dict[str, int] = {}
+    self._ledger = ledger
     self._exec = None
     self._outer = 0
     # Per-shard env fleets: the fleet-width leaves split over the data
@@ -397,6 +401,13 @@ class AnakinLoop(TargetNetwork):
           donate_argnums=(0, 1, 2)).lower(*args).compile()
       self.compile_counts["anakin_step"] = (
           self.compile_counts.get("anakin_step", 0) + 1)
+      if self._ledger is not None:
+        self._ledger.register(
+            "anakin_step", compiled=self._exec,
+            device=f"mesh{dict(self.mesh.shape)}",
+            shapes={"inner_steps": self.inner_steps,
+                    "fleet": self._env.num_envs,
+                    "batch": self._buffer.sample_batch_size})
     return self._exec
 
   def step(self, train_state):
@@ -408,16 +419,21 @@ class AnakinLoop(TargetNetwork):
     if self._target_variables is None:
       raise ValueError("call refresh(variables, step=0) before step()")
     exec_ = self.compiled(train_state)
-    t0 = time.perf_counter()
-    train_state, env_state, buffer_state, metrics = exec_(
-        train_state, self._env_state, self._buffer.state,
-        self._target_variables, jnp.asarray(self._outer, jnp.int32))
-    # device_get blocks until the fused program finishes: the clock
-    # stops exactly at the end of device work + the scalar D2H, so the
-    # bookkeeping below is measurable host time, not hidden inside the
-    # "in executable" bucket.
-    metrics = jax.device_get(metrics)
-    self.exec_seconds += time.perf_counter() - t0
+    with trace_lib.span("learn/anakin_step", inner=self.inner_steps,
+                        fused="act,step,extend,learn"):
+      t0 = time.perf_counter()
+      train_state, env_state, buffer_state, metrics = exec_(
+          train_state, self._env_state, self._buffer.state,
+          self._target_variables, jnp.asarray(self._outer, jnp.int32))
+      # device_get blocks until the fused program finishes: the clock
+      # stops exactly at the end of device work + the scalar D2H, so the
+      # bookkeeping below is measurable host time, not hidden inside the
+      # "in executable" bucket.
+      metrics = jax.device_get(metrics)
+      dispatch_seconds = time.perf_counter() - t0
+    self.exec_seconds += dispatch_seconds
+    if self._ledger is not None:
+      self._ledger.record_dispatch("anakin_step", dispatch_seconds)
     self._env_state = env_state
     self._buffer.set_state(buffer_state)
     self._outer += 1

@@ -29,12 +29,14 @@ later off-policy systems settled on, so both are offered: pass
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tensor2robot_tpu.obs import ledger as obs_ledger
 from tensor2robot_tpu.research.qtopt import cem
 
 
@@ -179,6 +181,7 @@ class BellmanUpdater(TargetNetwork):
       iterations: int = 2,
       seed: int = 0,
       polyak_tau: Optional[float] = None,
+      ledger: Optional[obs_ledger.ExecutableLedger] = None,
   ):
     """Args:
       model: a CriticModel (loss_type decides target value space: the
@@ -207,6 +210,7 @@ class BellmanUpdater(TargetNetwork):
     # fn name -> number of XLA compiles; the replay smoke asserts every
     # value is exactly 1 (fixed-shape sampling never recompiles).
     self.compile_counts: Dict[str, int] = {}
+    self._ledger = ledger
     self._targets_exec = None
     self._td_exec = None
     self._next_label_seed = 0
@@ -254,6 +258,8 @@ class BellmanUpdater(TargetNetwork):
     """
     executable = jax.jit(fn).lower(*args).compile()
     self.compile_counts[name] = self.compile_counts.get(name, 0) + 1
+    if self._ledger is not None:
+      self._ledger.register(name, compiled=executable)
     return executable
 
   def compute_targets(
@@ -283,8 +289,13 @@ class BellmanUpdater(TargetNetwork):
     if self._targets_exec is None:
       self._targets_exec = self._compile(
           "bellman_targets", self._build_targets_fn(), args)
+    start = time.perf_counter()
     targets, q_next = self._targets_exec(*args)
-    return np.asarray(targets), np.asarray(q_next)
+    targets, q_next = np.asarray(targets), np.asarray(q_next)
+    if self._ledger is not None:
+      self._ledger.record_dispatch("bellman_targets",
+                                   time.perf_counter() - start)
+    return targets, q_next
 
   def td_errors(self, variables, batch,
                 targets: np.ndarray) -> np.ndarray:
@@ -300,4 +311,9 @@ class BellmanUpdater(TargetNetwork):
     args = (variables, images, actions, targets)
     if self._td_exec is None:
       self._td_exec = self._compile("td_error", self._build_td_fn(), args)
-    return np.asarray(self._td_exec(*args))
+    start = time.perf_counter()
+    td = np.asarray(self._td_exec(*args))
+    if self._ledger is not None:
+      self._ledger.record_dispatch("td_error",
+                                   time.perf_counter() - start)
+    return td

@@ -46,11 +46,15 @@ import logging
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
+import time
+
 import flax.struct
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tensor2robot_tpu.obs import ledger as obs_ledger
+from tensor2robot_tpu.obs import trace as trace_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.replay.bellman import (TargetNetwork,
                                              make_bellman_targets_fn,
@@ -194,11 +198,15 @@ class DeviceReplayBuffer:
       mesh: Optional[jax.sharding.Mesh] = None,
       data_axis: str = "data",
       shard_capacity: bool = True,
+      ledger: Optional[obs_ledger.ExecutableLedger] = None,
   ):
     """shard_capacity=False keeps a DELIBERATELY replicated ring on a
     multi-device mesh (every device holds the full capacity — correct,
     just memory-expensive). The default shards the capacity axis and
-    REFUSES indivisible capacities instead of silently replicating."""
+    REFUSES indivisible capacities instead of silently replicating.
+    `ledger` (optional): obs.ledger.ExecutableLedger the host-facing
+    executables register into with cost_analysis + dispatch timing —
+    the first-class form of `compile_counts`, which stays as-is."""
     if capacity < 1:
       raise ValueError(f"capacity must be >= 1, got {capacity}")
     if sample_batch_size < 1:
@@ -255,6 +263,7 @@ class DeviceReplayBuffer:
     self._sample_calls = 0
     # fn name -> number of XLA compiles; tests assert every value is 1.
     self.compile_counts: Dict[str, int] = {}
+    self._ledger = ledger
     self._extend_exec = None
     self._sample_exec = None
     self._update_exec = None
@@ -420,6 +429,11 @@ class DeviceReplayBuffer:
     executable rejects any later shape drift instead of retracing."""
     executable = jax.jit(fn, donate_argnums=donate).lower(*args).compile()
     self.compile_counts[name] = self.compile_counts.get(name, 0) + 1
+    if self._ledger is not None:
+      self._ledger.register(
+          name, compiled=executable, device=f"mesh{dict(self.mesh.shape)}",
+          shapes={"capacity": self.capacity, "chunk": self.ingest_chunk,
+                  "batch": self.sample_batch_size})
     return executable
 
   def append(self, transition) -> int:
@@ -459,7 +473,14 @@ class DeviceReplayBuffer:
       self._extend_exec = self._compile(
           "device_extend", self.extend_fn(), (self._state, stacked),
           donate=(0,))
-    self._state = self._extend_exec(self._state, stacked)
+    with trace_lib.span("extend/device_chunk", chunk=chunk):
+      start = time.perf_counter()
+      self._state = self._extend_exec(self._state, stacked)
+      if self._ledger is not None:
+        # Dispatch-only timing (the staged extend is fire-and-forget);
+        # attribution treats it as a lower bound — ledger docstring.
+        self._ledger.record_dispatch("device_extend",
+                                     time.perf_counter() - start)
 
   def sample(self) -> Tuple[ts.TensorSpecStruct, SampleInfo]:
     """One fixed-shape batch + SampleInfo, as host numpy (ReplayBuffer
@@ -473,8 +494,12 @@ class DeviceReplayBuffer:
       if self._sample_exec is None:
         self._sample_exec = self._compile(
             "device_sample", self.sample_fn(), (self._state, key))
+      start = time.perf_counter()
       batch, indices, probabilities, staleness = jax.device_get(
           self._sample_exec(self._state, key))
+      if self._ledger is not None:
+        self._ledger.record_dispatch("device_sample",
+                                     time.perf_counter() - start)
     return (
         ts.TensorSpecStruct({k: np.asarray(v) for k, v in batch.items()}),
         SampleInfo(
@@ -501,7 +526,11 @@ class DeviceReplayBuffer:
             f"device_update_priorities_n{n}",
             self.update_priorities_fn(),
             (self._state, indices, td), donate=(0,))
+      start = time.perf_counter()
       self._state = self._update_exec[n](self._state, indices, td)
+      if self._ledger is not None:
+        self._ledger.record_dispatch(f"device_update_priorities_n{n}",
+                                     time.perf_counter() - start)
 
   def priorities(self, indices) -> np.ndarray:
     """Leaf priorities at `indices` (host float32) — the round-trip
@@ -650,6 +679,7 @@ class MegastepLearner(TargetNetwork):
       inner_steps: int = 10,
       seed: int = 0,
       polyak_tau: Optional[float] = None,
+      ledger: Optional[obs_ledger.ExecutableLedger] = None,
   ):
     if inner_steps < 1:
       raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
@@ -669,6 +699,7 @@ class MegastepLearner(TargetNetwork):
     self._clip_targets = getattr(model, "loss_type",
                                  "cross_entropy") == "cross_entropy"
     self.compile_counts: Dict[str, int] = {}
+    self._ledger = ledger
     self._exec = None
     self._outer = 0
     self._label_seed = 0
@@ -741,6 +772,12 @@ class MegastepLearner(TargetNetwork):
           donate_argnums=(0, 1)).lower(*args).compile()
       self.compile_counts["megastep"] = (
           self.compile_counts.get("megastep", 0) + 1)
+      if self._ledger is not None:
+        self._ledger.register(
+            "megastep", compiled=self._exec,
+            device=f"mesh{dict(self._trainer.mesh.shape)}",
+            shapes={"inner_steps": self.inner_steps,
+                    "batch": self._buffer.sample_batch_size})
     return self._exec
 
   def step(self, train_state):
@@ -753,15 +790,23 @@ class MegastepLearner(TargetNetwork):
     if self._target_variables is None:
       raise ValueError("call refresh(variables, step=0) before step()")
     exec_ = self.compiled(train_state)
-    train_state, buffer_state, metrics = exec_(
-        train_state, self._buffer.state,
-        self._target_variables,
-        jnp.asarray(self._outer, jnp.int32),
-        jnp.asarray(self._label_seed, jnp.uint32))
+    with trace_lib.span("learn/megastep", k=self.inner_steps):
+      start = time.perf_counter()
+      train_state, buffer_state, metrics = exec_(
+          train_state, self._buffer.state,
+          self._target_variables,
+          jnp.asarray(self._outer, jnp.int32),
+          jnp.asarray(self._label_seed, jnp.uint32))
+      # The device_get below blocks on the scanned program's metrics, so
+      # the measured window covers device work + the scalar D2H.
+      metrics = jax.device_get(metrics)
+      if self._ledger is not None:
+        self._ledger.record_dispatch("megastep",
+                                     time.perf_counter() - start)
     self._buffer.set_state(buffer_state)
     self._outer += 1
     self._label_seed = (self._label_seed
                         + self.inner_steps * self._buffer.sample_batch_size
                         ) % (2 ** 32)
     return train_state, {key: float(value)
-                         for key, value in jax.device_get(metrics).items()}
+                         for key, value in metrics.items()}

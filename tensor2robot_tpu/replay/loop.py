@@ -39,14 +39,20 @@ train/eval TD) — the replay-health block a production loop pages on.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+import types
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import optax
 
+from tensor2robot_tpu.obs import flight_recorder as flight_lib
+from tensor2robot_tpu.obs import ledger as obs_ledger
+from tensor2robot_tpu.obs import registry as registry_lib
+from tensor2robot_tpu.obs import trace as trace_lib
 from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
 from tensor2robot_tpu.replay.bellman import BellmanUpdater
 from tensor2robot_tpu.replay.ingest import ReplayFeeder, TransitionQueue
@@ -126,11 +132,13 @@ class CollectorWorker:
                num_envs: int = 4, max_attempts: int = 4,
                seed: int = 0, grasp_radius: float = 0.35,
                exploration_epsilon: float = 0.2,
-               scripted_fraction: float = 0.25):
+               scripted_fraction: float = 0.25,
+               flight_recorder=None):
     from tensor2robot_tpu.research.qtopt.synthetic_grasping import (
         GraspRetryEnv)
     self._policy = policy
     self._queue = queue
+    self._recorder = flight_recorder or flight_lib.get_recorder()
     # Exploration mix, QT-Opt parity: the reference's logs were seeded
     # by SCRIPTED grasps (its real-robot data was majority scripted
     # early on — synthetic_grasping.generate_grasps models the same
@@ -190,11 +198,16 @@ class CollectorWorker:
         self.step_once()
     except BaseException as e:  # noqa: BLE001 — surfaced via stop()
       self.errors.append(e)
+      # Loop-thread death is a flight-recorder trigger: the dump holds
+      # the spans/events right before this collector died.
+      self._recorder.trigger("collector_thread_exception",
+                             error=f"{type(e).__name__}: {e}")
 
   def step_once(self) -> None:
     """One lockstep control step across the whole env fleet."""
     images = [env.image for env in self._envs]
-    actions = np.asarray(self._policy(images))
+    with trace_lib.span("act/cem_policy", envs=len(self._envs)):
+      actions = np.asarray(self._policy(images))
     draw = self._explore_rng.random(len(self._envs))
     uniform = self._explore_rng.uniform(
         -1.0, 1.0, actions.shape).astype(np.float32)
@@ -314,6 +327,16 @@ class ReplayLoopConfig:
   mesh_dp: int = 0
   mesh_tp: int = 1
   zero1: Optional[bool] = None
+  # Windowed device-trace capture (ISSUE 11 satellite): (start, end)
+  # OPTIMIZER steps handed to utils.profiling.ProfilerHook — the same
+  # windowed jax.profiler capture train_eval runs, now available on
+  # every replay path (`run_qtopt_replay --profile START,END`). Steps
+  # are observed at the loop's cadence boundaries (per optimizer step
+  # on the host path, per dispatch on the fused paths), so the realized
+  # window snaps outward exactly as the hook documents; the guarded
+  # start_trace means a concurrently armed train-side ProfilerHook
+  # cannot double-start the profiler.
+  profile_window: Optional[Tuple[int, int]] = None
 
 
 class ReplayTrainLoop:
@@ -335,6 +358,16 @@ class ReplayTrainLoop:
     self.config = config
     self.logdir = logdir
     self.model = model if model is not None else self._default_model()
+    # Observability spine (ISSUE 11): one ExecutableLedger per loop run
+    # (every compiled program this loop owns registers + records
+    # dispatch time into it — the attribution in the result's `obs`
+    # block), the process registry as the metric namespace, and the
+    # process flight recorder pointed at THIS logdir so an SLO breach /
+    # thread death / loop exception dumps next to the run's metrics.
+    self.obs_ledger = obs_ledger.ExecutableLedger()
+    self.registry = registry_lib.get_registry()
+    self.recorder = flight_lib.get_recorder()
+    self.recorder.configure(dump_dir=logdir)
     mesh = None
     if config.mesh_dp:
       import jax
@@ -379,7 +412,8 @@ class ReplayTrainLoop:
       self.buffer = DeviceReplayBuffer(
           spec, config.capacity, config.batch_size, seed=config.seed,
           prioritized=config.prioritized,
-          ingest_chunk=chunk, mesh=self.trainer.mesh)
+          ingest_chunk=chunk, mesh=self.trainer.mesh,
+          ledger=self.obs_ledger)
     elif config.num_buffer_shards > 1:
       self.buffer = ShardedReplayBuffer(
           spec, config.capacity, config.batch_size,
@@ -429,7 +463,8 @@ class ReplayTrainLoop:
     return CEMFleetPolicy(
         predictor, action_size=c.action_size,
         num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
-        iterations=c.cem_iterations, seed=c.seed + 7, ladder=ladder)
+        iterations=c.cem_iterations, seed=c.seed + 7, ladder=ladder,
+        ledger=self.obs_ledger)
 
   def _eval_transitions(self):
     """Held-out random-action eval set WITH its analytic value targets.
@@ -521,7 +556,8 @@ class ReplayTrainLoop:
                         max_attempts=c.max_attempts,
                         seed=c.seed + i, grasp_radius=c.grasp_radius,
                         exploration_epsilon=c.exploration_epsilon,
-                        scripted_fraction=c.scripted_fraction)
+                        scripted_fraction=c.scripted_fraction,
+                        flight_recorder=self.recorder)
         for i in range(c.num_collectors)
     ]
     for collector in self._collectors:
@@ -542,6 +578,46 @@ class ReplayTrainLoop:
     self.writer.close()
     return errors
 
+  def _emit(self, step: int, scalars: Dict[str, float]) -> None:
+    """Metrics go THROUGH the process registry (gauges), then the one
+    registry→MetricWriter bridge flushes exactly this block — JSONL/TB
+    records keep the pre-registry schema while the registry holds the
+    same series process-wide for the obs bench and bench.py."""
+    self.registry.set_gauges(scalars)
+    self.registry.flush_to(self.writer, step, names=scalars.keys())
+
+  def _profile_hook(self):
+    """The --profile satellite: reuse ProfilerHook's windowed capture
+    (train_eval's instrument) on the replay paths. The guarded
+    start_trace in utils.profiling means this and a train-side hook
+    cannot double-start the profiler."""
+    if not self.config.profile_window:
+      return None
+    from tensor2robot_tpu.utils.profiling import ProfilerHook
+    start, end = self.config.profile_window
+    return ProfilerHook(start_step=start, end_step=end,
+                        log_dir=os.path.join(self.logdir, "profile"))
+
+  @staticmethod
+  def _profile_step(hook, step: int, final: bool = False) -> None:
+    if hook is None:
+      return
+    shim = types.SimpleNamespace(step=step)
+    if final:
+      hook.end(shim)
+    else:
+      hook.after_step(shim, {})
+
+  def _obs_block(self) -> Dict:
+    """Per-executable device-time attribution over this run's window."""
+    import jax
+    return {
+        "attribution": self.obs_ledger.attribution(
+            wall_seconds=time.perf_counter() - self._run_started,
+            device_kind=jax.devices()[0].device_kind),
+        "trace_stage_counts": trace_lib.get_tracer().stage_counts(),
+    }
+
   def _assemble_result(self, steps: int, initial_eval, eval_history,
                        ledger, param_refreshes: int, **extra) -> Dict:
     """The result schema both loop paths share (one copy: a new field
@@ -550,6 +626,7 @@ class ReplayTrainLoop:
     reduction = 1.0 - (final_eval["eval_td_error"]
                        / max(initial_eval["eval_td_error"], 1e-9))
     return {
+        "obs": self._obs_block(),
         "steps": steps,
         "initial_eval": initial_eval,
         "final_eval": {key: v for key, v in final_eval.items()
@@ -575,10 +652,23 @@ class ReplayTrainLoop:
 
   def run(self, num_steps: int) -> Dict:
     """Runs the closed loop for `num_steps` optimizer steps."""
-    if self.config.anakin:
-      return self._run_anakin(num_steps)
-    if self.config.device_resident:
-      return self._run_device_resident(num_steps)
+    self._run_started = time.perf_counter()
+    try:
+      if self.config.anakin:
+        return self._run_anakin(num_steps)
+      if self.config.device_resident:
+        return self._run_device_resident(num_steps)
+      return self._run_host(num_steps)
+    except Exception as e:
+      # An unhandled loop exception is a flight-recorder trigger: dump
+      # the last spans/events beside the run's metrics, then re-raise.
+      self.recorder.trigger("replay_loop_exception",
+                            error=f"{type(e).__name__}: {e}")
+      raise
+
+  def _run_host(self, num_steps: int) -> Dict:
+    """The PR 2 host-path loop (threaded collectors + per-step host
+    sample/label/train) — the measured fallback."""
     c = self.config
     state = self.trainer.create_train_state(batch_size=c.batch_size)
     # Host snapshot feeds the collector predictor and the target net
@@ -595,9 +685,10 @@ class ReplayTrainLoop:
         gamma=c.gamma,
         num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
         iterations=c.cem_iterations, seed=c.seed + 13,
-        polyak_tau=c.polyak_tau)
+        polyak_tau=c.polyak_tau, ledger=self.obs_ledger)
 
     self._start_collectors(policy)
+    profile_hook = self._profile_hook()
 
     try:
       self._wait_for_min_fill()
@@ -605,14 +696,14 @@ class ReplayTrainLoop:
       online = state.variables(use_ema=True)
       initial_eval = self._eval(updater, online, eval_batches,
                                 eval_q_stars)
-      self.writer.write_scalars(
-          0, {"replay/" + k: v for k, v in initial_eval.items()})
+      self._emit(0, {"replay/" + k: v for k, v in initial_eval.items()})
 
       train_step = None
       eval_history = [dict(step=0, **initial_eval)]
       final_metrics: Dict[str, float] = {}
       for step in range(1, num_steps + 1):
-        self.feeder.drain()
+        with trace_lib.span("extend/drain"):
+          self.feeder.drain()
         batch, info = self.buffer.sample()
         targets, q_next = updater.compute_targets(batch)
         features = {"image": np.asarray(batch["image"]),
@@ -626,12 +717,20 @@ class ReplayTrainLoop:
           train_step = self.trainer.aot_train_step(state, *sharded)
           self.compile_counts["train_step"] = (
               self.compile_counts.get("train_step", 0) + 1)
-        state, metrics = train_step(state, *sharded)
+          self.obs_ledger.register(
+              "train_step", compiled=train_step,
+              shapes={"batch": c.batch_size})
+        with trace_lib.span("learn/train_step"):
+          dispatch_start = time.perf_counter()
+          state, metrics = train_step(state, *sharded)
+          self.obs_ledger.record_dispatch(
+              "train_step", time.perf_counter() - dispatch_start)
         # Valid until the NEXT train_step donates these buffers away;
         # every read below happens before that.
         online = state.variables(use_ema=True)
         td = updater.td_errors(online, batch, targets)
         self.buffer.update_priorities(info.indices, td)
+        self._profile_step(profile_hook, step)
 
         if step % c.refresh_every == 0:
           # The hot-reload path: collectors and the target net pull the
@@ -652,14 +751,15 @@ class ReplayTrainLoop:
               **self.buffer.metrics(),
               **self.feeder.metrics(),
           }
-          self.writer.write_scalars(step, final_metrics)
+          self._emit(step, final_metrics)
         if step % c.eval_every == 0 or step == num_steps:
-          evals = self._eval(updater, online, eval_batches,
-                             eval_q_stars)
+          with trace_lib.span("replay/eval"):
+            evals = self._eval(updater, online, eval_batches,
+                               eval_q_stars)
           eval_history.append(dict(step=step, **evals))
-          self.writer.write_scalars(
-              step, {"replay/" + k: v for k, v in evals.items()})
+          self._emit(step, {"replay/" + k: v for k, v in evals.items()})
     finally:
+      self._profile_step(profile_hook, num_steps, final=True)
       collector_errors = self._shutdown_collectors()
     if collector_errors:
       raise RuntimeError(
@@ -704,18 +804,20 @@ class ReplayTrainLoop:
         self.model, host_variables, action_size=c.action_size,
         gamma=c.gamma, num_samples=c.cem_num_samples,
         num_elites=c.cem_num_elites, iterations=c.cem_iterations,
-        seed=c.seed + 13, polyak_tau=c.polyak_tau)
+        seed=c.seed + 13, polyak_tau=c.polyak_tau,
+        ledger=self.obs_ledger)
     learner = MegastepLearner(
         self.model, self.trainer, self.buffer,
         action_size=c.action_size, gamma=c.gamma,
         num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
         iterations=c.cem_iterations, inner_steps=k, seed=c.seed + 13,
-        polyak_tau=c.polyak_tau)
+        polyak_tau=c.polyak_tau, ledger=self.obs_ledger)
     # Cold-start target = initial online copy (BellmanUpdater parity);
     # this counts as refresh 0, not a loop refresh.
     learner.refresh(host_variables, step=0)
 
     self._start_collectors(policy)
+    profile_hook = self._profile_hook()
 
     try:
       self._wait_for_min_fill()
@@ -723,16 +825,18 @@ class ReplayTrainLoop:
       online = state.variables(use_ema=True)
       initial_eval = self._eval(updater, online, eval_batches,
                                 eval_q_stars)
-      self.writer.write_scalars(
-          0, {"replay/" + key: v for key, v in initial_eval.items()})
+      self._emit(0, {"replay/" + key: v
+                     for key, v in initial_eval.items()})
 
       eval_history = [dict(step=0, **initial_eval)]
       final_metrics: Dict[str, float] = {}
       prev_step = 0
       for outer in range(1, num_outer + 1):
-        self.feeder.drain()
+        with trace_lib.span("extend/drain"):
+          self.feeder.drain()
         state, metrics = learner.step(state)
         step = outer * k
+        self._profile_step(profile_hook, step)
         # Cadences count OPTIMIZER steps: an event fires when its
         # multiple falls inside this megastep's [prev_step+1, step].
         crossed = lambda every: (step // every) > (prev_step // every)
@@ -754,17 +858,19 @@ class ReplayTrainLoop:
               **self.buffer.metrics(),
               **self.feeder.metrics(),
           }
-          self.writer.write_scalars(step, final_metrics)
+          self._emit(step, final_metrics)
         if crossed(c.eval_every) or outer == num_outer:
           # Valid until the NEXT megastep donates the state away.
           online = state.variables(use_ema=True)
-          evals = self._eval(updater, online, eval_batches,
-                             eval_q_stars)
+          with trace_lib.span("replay/eval"):
+            evals = self._eval(updater, online, eval_batches,
+                               eval_q_stars)
           eval_history.append(dict(step=step, **evals))
-          self.writer.write_scalars(
-              step, {"replay/" + key: v for key, v in evals.items()})
+          self._emit(step,
+                     {"replay/" + key: v for key, v in evals.items()})
         prev_step = step
     finally:
+      self._profile_step(profile_hook, num_outer * k, final=True)
       collector_errors = self._shutdown_collectors()
     if collector_errors:
       raise RuntimeError(
@@ -808,7 +914,8 @@ class ReplayTrainLoop:
         self.model, host_variables, action_size=c.action_size,
         gamma=c.gamma, num_samples=c.cem_num_samples,
         num_elites=c.cem_num_elites, iterations=c.cem_iterations,
-        seed=c.seed + 13, polyak_tau=c.polyak_tau)
+        seed=c.seed + 13, polyak_tau=c.polyak_tau,
+        ledger=self.obs_ledger)
     # Scene bank: the ONE-TIME host render (the oracle's own code);
     # after this the host never touches a scene again.
     bank = make_scene_bank(c.anakin_bank_scenes,
@@ -824,14 +931,15 @@ class ReplayTrainLoop:
         train_every=c.anakin_train_every, min_fill=c.min_fill,
         exploration_epsilon=c.exploration_epsilon,
         scripted_fraction=c.scripted_fraction, seed=c.seed + 13,
-        polyak_tau=c.polyak_tau)
+        polyak_tau=c.polyak_tau, ledger=self.obs_ledger)
     loop.refresh(host_variables, step=0)
+    profile_hook = self._profile_hook()
 
     eval_batches, eval_q_stars = self._eval_transitions()
     initial_eval = self._eval(updater, state.variables(use_ema=True),
                               eval_batches, eval_q_stars)
-    self.writer.write_scalars(
-        0, {"replay/" + key: v for key, v in initial_eval.items()})
+    self._emit(0, {"replay/" + key: v
+                   for key, v in initial_eval.items()})
 
     eval_history = [dict(step=0, **initial_eval)]
     prev_step = 0
@@ -853,6 +961,7 @@ class ReplayTrainLoop:
         state, metrics = loop.step(state)
         dispatches += 1
         step = loop.trained_steps
+        self._profile_step(profile_hook, step)
         crossed = lambda every: (step // every) > (prev_step // every)
         done = step >= num_steps
 
@@ -861,7 +970,7 @@ class ReplayTrainLoop:
           loop.refresh(host_variables, step)
           updater.refresh(host_variables, step)
         if (crossed(c.log_every) or done) and metrics["trained_steps"]:
-          self.writer.write_scalars(step, {
+          self._emit(step, {
               "replay/train_loss": metrics["loss"],
               "replay/train_td_error": metrics["td_error"],
               "replay/train_q_next": metrics["q_next"],
@@ -874,13 +983,15 @@ class ReplayTrainLoop:
         if crossed(c.eval_every) or done:
           # Valid until the NEXT dispatch donates the state away.
           online = state.variables(use_ema=True)
-          evals = self._eval(updater, online, eval_batches,
-                             eval_q_stars)
+          with trace_lib.span("replay/eval"):
+            evals = self._eval(updater, online, eval_batches,
+                               eval_q_stars)
           eval_history.append(dict(step=step, **evals))
-          self.writer.write_scalars(
-              step, {"replay/" + key: v for key, v in evals.items()})
+          self._emit(step,
+                     {"replay/" + key: v for key, v in evals.items()})
         prev_step = step
     finally:
+      self._profile_step(profile_hook, loop.trained_steps, final=True)
       self.writer.close()
 
     ledger = dict(self.compile_counts)

@@ -35,9 +35,10 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Optional, Sequence
 
+from tensor2robot_tpu.obs import flight_recorder as flight_lib
+from tensor2robot_tpu.obs import trace as trace_lib
 from tensor2robot_tpu.serving.slo import RequestShed, SLOClass
 from tensor2robot_tpu.serving.stats import ServingStats
-from tensor2robot_tpu.utils import profiling
 
 
 class _Request:
@@ -91,12 +92,16 @@ class MicroBatcher:
                stats: Optional[ServingStats] = None,
                bucket_for: Optional[Callable[[int], int]] = None,
                max_queue: Optional[int] = None,
-               dispatch_margin_ms: float = 0.0):
+               dispatch_margin_ms: float = 0.0,
+               flight_recorder: Optional[flight_lib.FlightRecorder] = None):
     """See class docstring. `dispatch_margin_ms` budgets the flush's own
     cost: a partial batch ships `margin` BEFORE its head's deadline, so
     a class's p99 can actually sit inside its budget (set it to a
     comfortable bound on one flush; 0 keeps the legacy flush-AT-deadline
-    behavior)."""
+    behavior). `flight_recorder` (default: the process recorder)
+    receives every shed as an SLO-breach trigger and the dispatcher's
+    unhandled exceptions — dumps fire only once a dump_dir is
+    configured on it."""
     if max_batch < 1:
       raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     if deadline_ms < 0:
@@ -113,6 +118,7 @@ class MicroBatcher:
     self._stats = stats
     self._bucket_for = bucket_for or (lambda n: n)
     self._max_queue = max_queue
+    self._recorder = flight_recorder or flight_lib.get_recorder()
     # Min-heap of (deadline, seq, request); shed entries stay in the
     # heap with request.shed=True and are skipped on pop (lazy
     # deletion), _live tracks the real pending count.
@@ -281,8 +287,21 @@ class MicroBatcher:
   def _shed(self, request: _Request, reason: str) -> None:
     if self._stats is not None:
       self._stats.record_shed(request.slo.name, reason)
+    # Resolve the victim's future FIRST: the diagnostics below must
+    # never leave a shed client blocked on result().
     if request.future.set_running_or_notify_cancel():
       request.future.set_exception(RequestShed(request.slo.name, reason))
+    # Every shed is an SLO breach the fleet promised to account for:
+    # trigger a flight-recorder dump (rate-limited; ring-only when no
+    # dump_dir is configured) so the spans/events leading up to the
+    # breach survive for the post-mortem. Best-effort: a failing dump
+    # (full disk, unwritable dir) must not convert a correctly-shed
+    # request into a submit()-side storage error.
+    try:
+      self._recorder.trigger("slo_breach", slo_class=request.slo.name,
+                             shed_reason=reason)
+    except Exception:
+      pass
 
   # -- dispatcher ----------------------------------------------------------
 
@@ -296,6 +315,8 @@ class MicroBatcher:
       except Exception as e:  # e.g. a raising bucket_for/stats hook —
         # the dispatcher must outlive ANY flush failure or every
         # queued and future request hangs unresolved.
+        self._recorder.trigger("batcher_dispatcher_exception",
+                               error=f"{type(e).__name__}: {e}")
         for request in batch:
           if not request.future.done():
             try:
@@ -360,10 +381,13 @@ class MicroBatcher:
     batch = [r for r in batch if r.future.set_running_or_notify_cancel()]
     if not batch:
       return
-    with profiling.annotate(f"serving/flush_b{len(batch)}"):
+    with trace_lib.span("serve/flush", batch=len(batch)):
       try:
         results = self._batch_fn([r.item for r in batch])
       except Exception as e:  # fail the flush's requests, not the loop
+        self._recorder.record("event", "flush_failed",
+                              error=f"{type(e).__name__}: {e}",
+                              batch=len(batch))
         for request in batch:
           request.future.set_exception(e)
         return

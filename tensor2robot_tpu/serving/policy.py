@@ -18,12 +18,14 @@ batch position, and bucket padding (see cem.fleet_cem_optimize).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tensor2robot_tpu.obs import ledger as ledger_lib
 from tensor2robot_tpu.research.qtopt import cem
 from tensor2robot_tpu.serving import bucketing
 from tensor2robot_tpu.serving.bucketing import BucketLadder
@@ -43,13 +45,19 @@ class CEMFleetPolicy:
                num_samples: int = 64, num_elites: int = 6,
                iterations: int = 3, seed: int = 0,
                ladder: Optional[BucketLadder] = None,
-               device=None):
+               device=None,
+               ledger: Optional[ledger_lib.ExecutableLedger] = None):
     """See class docstring. `device` pins this policy's executables and
     inputs to ONE jax.Device — the fleet router's replica placement
     (serving/router.py): each mesh device gets its own policy whose
     ladder compiles exactly once per bucket PER DEVICE, and request
     batches are device_put onto that replica before dispatch. None
-    keeps the default placement (single-chip behavior, unchanged)."""
+    keeps the default placement (single-chip behavior, unchanged).
+    `ledger` (optional): an obs.ledger.ExecutableLedger that each
+    bucket registers into (cost_analysis joined) and whose dispatch
+    wall time the call path records — entries are keyed
+    ``cem_bucket_<n>`` plus ``@<device>`` when pinned, so a fleet's
+    per-device replicas stay distinct rows."""
     self._predictor = predictor
     self._action_size = action_size
     self._num_samples = num_samples
@@ -58,6 +66,7 @@ class CEMFleetPolicy:
     self._seed = seed
     self.ladder = ladder or BucketLadder()
     self.device = device
+    self._ledger = ledger
     # (id -> (variables, placed)) single-digit cache: the live params
     # plus a rollout candidate sharing this replica's executables. The
     # stored variables ref pins the id (no reuse-after-GC aliasing);
@@ -115,9 +124,22 @@ class CEMFleetPolicy:
     padded_seeds, _ = self.ladder.pad_batch(seeds)
     compiled = self._executable_for(bucket, fn, variables, padded,
                                     padded_seeds)
-    actions = compiled(variables, self._put(padded),
-                       self._put(padded_seeds))
-    return np.asarray(actions)[:n]
+    if self._ledger is None:
+      actions = compiled(variables, self._put(padded),
+                         self._put(padded_seeds))
+      return np.asarray(actions)[:n]
+    # Ledger path: the host→numpy conversion below synchronizes on the
+    # result, so the measured window is dispatch through completion.
+    start = time.perf_counter()
+    actions = np.asarray(compiled(variables, self._put(padded),
+                                  self._put(padded_seeds)))
+    self._ledger.record_dispatch(self._ledger_key(bucket),
+                                 time.perf_counter() - start)
+    return actions[:n]
+
+  def _ledger_key(self, bucket: int) -> str:
+    suffix = f"@{self.device}" if self.device is not None else ""
+    return f"cem_bucket_{bucket}{suffix}"
 
   # -- device placement ----------------------------------------------------
 
@@ -184,6 +206,13 @@ class CEMFleetPolicy:
         self._executables[bucket] = compiled
         self.compile_counts[bucket] = (
             self.compile_counts.get(bucket, 0) + 1)
+        if self._ledger is not None:
+          self._ledger.register(
+              self._ledger_key(bucket), compiled=compiled,
+              device=self.device,
+              shapes={"bucket": bucket,
+                      "num_samples": self._num_samples,
+                      "iterations": self._iterations})
     return compiled
 
   # -- host fallback -------------------------------------------------------

@@ -46,6 +46,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from tensor2robot_tpu.obs import flight_recorder as flight_lib
 from tensor2robot_tpu.serving.batcher import MicroBatcher
 from tensor2robot_tpu.serving.router import FleetRouter
 from tensor2robot_tpu.serving.slo import SLOClass
@@ -173,10 +174,12 @@ class RolloutController:
                config: Optional[RolloutConfig] = None,
                q_fn: Optional[Callable] = None,
                watcher: Optional[ExportWatcher] = None,
-               poll_s: float = 0.2):
+               poll_s: float = 0.2,
+               flight_recorder=None):
     self._router = router
     self._predictor = predictor
     self._config = config or RolloutConfig()
+    self._recorder = flight_recorder or flight_lib.get_recorder()
     self._q_fn = q_fn or self._default_q_fn
     self._watcher = watcher
     self._poll_s = poll_s
@@ -395,7 +398,9 @@ class RolloutController:
         else:
           _, payload = item
           self._consume_pair(payload)
-      except Exception:
+      except Exception as e:
+        self._recorder.trigger("rollout_worker_exception",
+                               error=f"{type(e).__name__}: {e}")
         _log.exception("rollout worker step failed; continuing")
 
   def _tick(self) -> None:
@@ -545,4 +550,14 @@ class RolloutController:
     entry.update(fields)
     with self._lock:
       self.events.append(entry)
+    # Rollout events join the flight-recorder ring; an auto-rollback is
+    # a post-mortem trigger — the dump carries the shadow/canary spans
+    # and metrics that led to the decision.
+    if event == "auto_rollback":
+      self._recorder.trigger(
+          "rollout_auto_rollback",
+          version=fields.get("version"), stage=fields.get("stage"))
+    else:
+      self._recorder.record("event", f"rollout_{event}",
+                            version=fields.get("version"))
     _log.info("rollout %s: %s", event, fields)

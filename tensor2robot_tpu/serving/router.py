@@ -34,6 +34,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from tensor2robot_tpu.obs import flight_recorder as flight_lib
+from tensor2robot_tpu.obs import ledger as ledger_lib
 from tensor2robot_tpu.serving.batcher import MicroBatcher
 from tensor2robot_tpu.serving.policy import CEMFleetPolicy
 from tensor2robot_tpu.serving.slo import SLOClass
@@ -45,13 +47,15 @@ class PolicyReplica:
 
   def __init__(self, policy: CEMFleetPolicy, max_batch: int,
                deadline_ms: float, stats: ServingStats,
-               max_queue: Optional[int], dispatch_margin_ms: float):
+               max_queue: Optional[int], dispatch_margin_ms: float,
+               flight_recorder=None):
     self.policy = policy
     self.device = policy.device
     self.batcher = MicroBatcher(
         self._flush, max_batch=max_batch, deadline_ms=deadline_ms,
         stats=stats, bucket_for=policy.ladder.bucket_for,
-        max_queue=max_queue, dispatch_margin_ms=dispatch_margin_ms)
+        max_queue=max_queue, dispatch_margin_ms=dispatch_margin_ms,
+        flight_recorder=flight_recorder)
 
   def _flush(self, items):
     images = [item[0] for item in items]
@@ -94,7 +98,9 @@ class FleetRouter:
                max_queue: Optional[int] = None,
                dispatch_margin_ms: float = 0.0,
                stats: Optional[ServingStats] = None,
-               metric_writer=None):
+               metric_writer=None,
+               ledger: Optional[ledger_lib.ExecutableLedger] = None,
+               flight_recorder=None):
     import jax
 
     from tensor2robot_tpu.serving.bucketing import BucketLadder
@@ -109,6 +115,12 @@ class FleetRouter:
     self._seed_lock = threading.Lock()
     self._next_seed = 0
     self._rr = itertools.count()  # least-loaded tie-break rotation
+    # Observability spine (ISSUE 11): one ExecutableLedger spanning all
+    # replicas (per-device rows via the policies' @device keys) and one
+    # flight recorder shared by every replica's batcher (default: the
+    # process recorder — ring-only until a dump_dir is configured).
+    self.ledger = ledger if ledger is not None else ledger_lib.ExecutableLedger()
+    self._recorder = flight_recorder or flight_lib.get_recorder()
     self.replicas = []
     for device in devices:
       ladder = (BucketLadder(ladder_sizes) if ladder_sizes is not None
@@ -116,7 +128,7 @@ class FleetRouter:
       policy = CEMFleetPolicy(
           predictor, action_size=action_size, num_samples=num_samples,
           num_elites=num_elites, iterations=iterations, seed=seed,
-          ladder=ladder, device=device)
+          ladder=ladder, device=device, ledger=self.ledger)
       replica_max_batch = (ladder.max_batch if max_batch is None
                            else max_batch)
       if replica_max_batch > ladder.max_batch:
@@ -125,7 +137,7 @@ class FleetRouter:
             f"{ladder.max_batch}")
       self.replicas.append(PolicyReplica(
           policy, replica_max_batch, deadline_ms, self.stats, max_queue,
-          dispatch_margin_ms))
+          dispatch_margin_ms, flight_recorder=self._recorder))
 
   # -- lifecycle -----------------------------------------------------------
 

@@ -16,16 +16,14 @@ floats, so a snapshot can go straight into
 from __future__ import annotations
 
 import collections
-import math
 import threading
 from typing import Dict, Optional
 
-
-def _nearest_rank(ordered, pct: float) -> float:
-  """Nearest-rank percentile: smallest sample with >= pct% at or below."""
-  rank = min(len(ordered) - 1,
-             max(0, math.ceil(pct / 100.0 * len(ordered)) - 1))
-  return ordered[rank]
+from tensor2robot_tpu.obs import registry as registry_lib
+# ONE percentile convention in the repo: the nearest-rank helper lives
+# with the obs registry's histograms; the serving histograms reuse it
+# so the two layers cannot drift.
+from tensor2robot_tpu.obs.registry import _nearest_rank
 
 
 class LatencyHistogram:
@@ -79,10 +77,20 @@ class _ClassStats:
 
 
 class ServingStats:
-  """Thread-safe counters for the micro-batching serving path."""
+  """Thread-safe counters for the micro-batching serving path.
 
-  def __init__(self):
+  Each instance is a WINDOWED view (benches swap a fresh one per sweep
+  point); every record additionally flows through the process-wide
+  ``obs.registry`` (ISSUE 11), so the registry holds process-lifetime
+  serving totals/latency under ``serving/...`` regardless of how many
+  windowed instances came and went. Pass ``registry=None`` explicitly
+  via ``obs.registry.MetricRegistry()`` to isolate (tests).
+  """
+
+  def __init__(self,
+               registry: Optional[registry_lib.MetricRegistry] = None):
     self._lock = threading.Lock()
+    self._registry = registry or registry_lib.get_registry()
     self.latency = LatencyHistogram()
     self._requests = 0
     self._flushes = 0
@@ -107,6 +115,12 @@ class ServingStats:
       cls = self._class(class_name)
       if cls is not None:
         cls.requests += 1
+    self._registry.counter("serving/requests").inc()
+    # Class-less traffic buckets under "default" — the same key
+    # record_shed uses, so the registry's per-class shed RATES always
+    # have a request denominator.
+    self._registry.counter(
+        f"serving/class/{class_name or 'default'}/requests").inc()
 
   def record_shed(self, class_name: Optional[str], reason: str) -> None:
     """One shed request: reason is "expired" (deadline already past at
@@ -121,6 +135,9 @@ class ServingStats:
         cls.shed_capacity += 1
       else:
         raise ValueError(f"unknown shed reason {reason!r}")
+    self._registry.counter(f"serving/shed_{reason}").inc()
+    self._registry.counter(
+        f"serving/class/{class_name or 'default'}/shed_{reason}").inc()
 
   def record_flush(self, batch_size: int, bucket: int,
                    queue_depth_after: int, deadline_expired: bool) -> None:
@@ -135,10 +152,13 @@ class ServingStats:
   def record_latency_ms(self, latency_ms: float,
                         class_name: Optional[str] = None) -> None:
     self.latency.record(latency_ms)
+    self._registry.histogram("serving/latency_ms").record(latency_ms)
     if class_name is not None:
       with self._lock:
         hist = self._class(class_name).latency
       hist.record(latency_ms)
+      self._registry.histogram(
+          f"serving/class/{class_name}/latency_ms").record(latency_ms)
 
   def snapshot(self) -> Dict[str, float]:
     """One dict: counters + derived ratios + latency percentiles, plus

@@ -32,12 +32,23 @@ from tensor2robot_tpu import modes
 from tensor2robot_tpu.config import configurable, operative_config_str
 from tensor2robot_tpu.data.prefetch import prefetch_to_device
 from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder
+from tensor2robot_tpu.obs import registry as registry_lib
 from tensor2robot_tpu.train.checkpoints import CheckpointManager
 from tensor2robot_tpu.train.trainer import Trainer
 from tensor2robot_tpu.train.train_state import TrainState
 from tensor2robot_tpu.utils.metric_writer import MetricWriter
 
 _log = logging.getLogger(__name__)
+
+
+def _emit_metrics(metric_writer, step: int, scalars) -> None:
+  """Trainer metrics go THROUGH the process-wide obs registry (gauges),
+  then the one registry→MetricWriter bridge flushes exactly this block
+  — the JSONL/TB records keep their schema, and the same series is
+  readable process-wide (obs bench, flight-recorder context)."""
+  registry = registry_lib.get_registry()
+  registry.set_gauges(scalars)
+  registry.flush_to(metric_writer, step, names=scalars.keys())
 
 
 class _PreemptionGuard:
@@ -360,7 +371,7 @@ def train_eval_model(
           host_metrics = {k: float(v) for k, v in pending_metrics.items()}
           train_metrics = host_metrics
           if metric_writer:
-            metric_writer.write_scalars(step, host_metrics)
+            _emit_metrics(metric_writer, step, host_metrics)
           for hook in hooks:
             hook.after_step(state, host_metrics)
           _log.info("step %d: %s", step, host_metrics)
@@ -383,8 +394,9 @@ def train_eval_model(
             and step < max_train_steps):
           eval_metrics = run_eval(state)
           if metric_writer and eval_metrics:
-            metric_writer.write_scalars(
-                step, {f"eval/{k}": v for k, v in eval_metrics.items()})
+            _emit_metrics(
+                metric_writer, step,
+                {f"eval/{k}": v for k, v in eval_metrics.items()})
       if preemption.requested:
         _log.warning("Preempted at step %d; final checkpoint below is the "
                      "resume point.", step)
@@ -401,8 +413,9 @@ def train_eval_model(
     if final_eval:
       eval_metrics = final_eval
       if metric_writer:
-        metric_writer.write_scalars(
-            int(state.step), {f"eval/{k}": v for k, v in eval_metrics.items()})
+        _emit_metrics(
+            metric_writer, int(state.step),
+            {f"eval/{k}": v for k, v in eval_metrics.items()})
 
     if export_generator is not None:
       from tensor2robot_tpu.export import export_utils
@@ -618,8 +631,8 @@ def continuous_eval_model(
                                     state, eval_steps, prefetch_depth)
         results[step] = metrics
         if metric_writer:
-          metric_writer.write_scalars(
-              step, {f"eval/{k}": v for k, v in metrics.items()})
+          _emit_metrics(metric_writer, step,
+                        {f"eval/{k}": v for k, v in metrics.items()})
           if images:
             metric_writer.write_images(
                 step, {f"eval/{k}": v for k, v in images.items()})

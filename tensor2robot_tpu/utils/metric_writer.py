@@ -27,24 +27,50 @@ except Exception:  # pragma: no cover - tensorboard ships with TF here.
 
 
 class MetricWriter:
-  """Writes scalar metrics to TB event files and metrics.jsonl."""
+  """Writes scalar metrics to TB event files and metrics.jsonl.
+
+  Usable as a context manager (``with MetricWriter(logdir) as w:``) so
+  loops cannot leak an open writer past an exception; writing after
+  ``close()`` raises instead of hitting a closed file deep inside the
+  json module. Every JSONL record carries ``host``/``pid`` — the
+  multi-host tier merges per-process metrics.jsonl streams, and a
+  record must say which process emitted it.
+  """
 
   def __init__(self, logdir: str):
     os.makedirs(logdir, exist_ok=True)
     self._logdir = logdir
+    self._host = socket.gethostname()
+    self._pid = os.getpid()
+    self._closed = False
     self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
     self._events: Optional[TFRecordWriter] = None
     if _HAVE_TB:
       fname = (f"events.out.tfevents.{int(time.time())}."
-               f"{socket.gethostname()}")
+               f"{self._host}")
       self._events = TFRecordWriter(os.path.join(logdir, fname))
       first = event_pb2.Event(
           wall_time=time.time(), file_version="brain.Event:2")
       self._events.write(first.SerializeToString())
 
+  def _check_open(self) -> None:
+    if self._closed:
+      raise RuntimeError(
+          f"MetricWriter for {self._logdir!r} is closed; writes after "
+          "close() indicate a lifecycle bug (a loop still logging "
+          "after shutdown)")
+
+  def __enter__(self) -> "MetricWriter":
+    return self
+
+  def __exit__(self, *exc_info) -> None:
+    self.close()
+
   def write_scalars(self, step: int, scalars: Mapping[str, float]) -> None:
+    self._check_open()
     now = time.time()
-    record: Dict[str, float] = {"step": int(step), "wall_time": now}
+    record: Dict[str, float] = {"step": int(step), "wall_time": now,
+                                "host": self._host, "pid": self._pid}
     record.update({k: float(v) for k, v in scalars.items()})
     self._jsonl.write(json.dumps(record) + "\n")
     if self._events is not None:
@@ -67,6 +93,7 @@ class MetricWriter:
     at sync points, PNG-encoded into the same event file TensorBoard
     reads. Best-effort: silently skipped without the TB proto or PIL.
     """
+    self._check_open()
     if self._events is None or not images:
       return
     import numpy as np
@@ -92,7 +119,10 @@ class MetricWriter:
       self._events.flush()
 
   def close(self) -> None:
+    if self._closed:
+      return  # idempotent: context-manager exit after an explicit close
     self.flush()
+    self._closed = True
     self._jsonl.close()
     if self._events is not None:
       self._events.close()

@@ -10,21 +10,84 @@ Perfetto.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
+import threading
 from typing import List, Optional
 
 import jax
 
 from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder
+from tensor2robot_tpu.obs import trace as obs_trace
 
 _log = logging.getLogger(__name__)
 
-# Re-exported so consumers have one profiling import surface;
-# jax.profiler.trace is already a context manager with the exact
-# start/stop semantics a wrapper would reimplement.
-trace = jax.profiler.trace
 annotate = jax.profiler.TraceAnnotation
+
+# Process-wide trace window guard (ISSUE 11 satellite): jax.profiler
+# raises on a second start_trace, and two capture paths can now both be
+# armed (the train ProfilerHook and the replay loop's --profile
+# window). Every capture in this repo goes through start_trace /
+# stop_trace below, so a second window logs-and-skips instead of
+# killing the loop that lost the race. The guard also flips the obs
+# tracer's device-annotation flag, so host spans appear as
+# TraceAnnotations exactly while a device trace can see them.
+_TRACE_LOCK = threading.Lock()
+_TRACE_DIR: Optional[str] = None
+
+
+def trace_active() -> bool:
+  """True while a guarded device-trace window is open."""
+  with _TRACE_LOCK:
+    return _TRACE_DIR is not None
+
+
+def start_trace(log_dir: str) -> bool:
+  """Starts a device trace unless one is already active.
+
+  Returns True on success; False (logged) when another window holds
+  the profiler — the caller should skip its window, not crash.
+  """
+  global _TRACE_DIR
+  with _TRACE_LOCK:
+    if _TRACE_DIR is not None:
+      _log.warning(
+          "profiler trace already active (-> %s); skipping a second "
+          "start_trace into %s", _TRACE_DIR, log_dir)
+      return False
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _TRACE_DIR = log_dir
+    # Inside the lock: the annotation flag must never disagree with
+    # the trace state under a concurrent start/stop race.
+    obs_trace.set_device_annotations(True)
+  return True
+
+
+def stop_trace() -> Optional[str]:
+  """Stops the guarded trace window; returns its log_dir (None if no
+  window was active — safe to call unconditionally on shutdown)."""
+  global _TRACE_DIR
+  with _TRACE_LOCK:
+    if _TRACE_DIR is None:
+      return None
+    log_dir, _TRACE_DIR = _TRACE_DIR, None
+    jax.profiler.stop_trace()
+    obs_trace.set_device_annotations(False)
+  return log_dir
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+  """Guarded replacement for jax.profiler.trace: the body runs either
+  way; the capture is skipped when another window is active."""
+  started = start_trace(log_dir)
+  try:
+    yield
+  finally:
+    if started:
+      stop_trace()
 
 
 class ProfilerHook(Hook):
@@ -58,8 +121,11 @@ class ProfilerHook(Hook):
       return
     step = int(state.step)
     if not self._tracing and step >= self._start_step:
-      os.makedirs(self._log_dir, exist_ok=True)
-      jax.profiler.start_trace(self._log_dir)
+      if not start_trace(self._log_dir):
+        # Another capture path holds the profiler (the double-
+        # start_trace guard): skip this hook's window entirely.
+        self._done = True
+        return
       self._tracing = True
       _log.info("Profiler trace started at step %d → %s", step,
                 self._log_dir)
@@ -67,14 +133,14 @@ class ProfilerHook(Hook):
       # one sync interval rather than silently skipping.
       return
     if self._tracing and step >= self._end_step:
-      jax.profiler.stop_trace()
+      stop_trace()
       self._tracing = False
       self._done = True
       _log.info("Profiler trace stopped at step %d.", step)
 
   def end(self, state) -> None:
     if self._tracing:
-      jax.profiler.stop_trace()
+      stop_trace()
       self._tracing = False
       self._done = True
       _log.info("Profiler trace stopped at end of training.")

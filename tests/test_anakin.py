@@ -546,15 +546,16 @@ class TestAnakinSmokeCLI:
 
   def test_ledger_exactly_one_anakin_executable(self,
                                                 anakin_smoke_results):
+    from tensor2robot_tpu.obs.ledger import check_compile_ledger
     results, _ = anakin_smoke_results
     ledger = results["compile_counts"]
-    assert ledger["anakin_step"] == 1
-    # The fused program subsumes every hot-path executable: no megastep,
-    # no host train step, no acting bucket, no host-fed extend.
-    for absent in ("megastep", "train_step", "device_extend"):
-      assert absent not in ledger, ledger
+    # The shared smoke helper (ISSUE 11 satellite): exactly-once
+    # everywhere, and the fused program subsumes every hot-path
+    # executable — no megastep, no host train step, no host-fed extend.
+    check_compile_ledger(
+        ledger, require=("anakin_step",),
+        forbid=("megastep", "train_step", "device_extend"))
     assert not any(key.startswith("cem_bucket_") for key in ledger)
-    assert all(value == 1 for value in ledger.values()), ledger
 
   def test_loop_collected_on_device(self, anakin_smoke_results):
     results, _ = anakin_smoke_results
@@ -678,11 +679,11 @@ class TestShardedAnakinSmokeCLI:
 
   def test_ledger_one_executable_on_the_pod_mesh(self,
                                                  sharded_smoke_results):
-    ledger = sharded_smoke_results["compile_counts"]
-    assert ledger["anakin_step"] == 1
-    for absent in ("megastep", "train_step", "device_extend"):
-      assert absent not in ledger, ledger
-    assert all(value == 1 for value in ledger.values()), ledger
+    from tensor2robot_tpu.obs.ledger import check_compile_ledger
+    check_compile_ledger(
+        sharded_smoke_results["compile_counts"],
+        require=("anakin_step",),
+        forbid=("megastep", "train_step", "device_extend"))
 
   def test_host_never_touches_a_transition(self, sharded_smoke_results):
     results = sharded_smoke_results

@@ -80,12 +80,14 @@ class TestFleetRouter:
                  for i in range(24)]
       for future in futures:
         assert np.asarray(future.result(timeout=30)).shape == (4,)
+    from tensor2robot_tpu.obs.ledger import check_compile_ledger
     ledger = router.compile_ledger()
     assert len(ledger) == 3
     for device_label, counts in ledger.items():
       assert sorted(counts) == [1, 2, 4], (device_label, counts)
-      assert all(count == 1 for count in counts.values()), (
-          device_label, counts)
+    # The shared smoke helper (ISSUE 11 satellite) flattens the nested
+    # {device: {bucket: count}} shape and asserts exactly-once.
+    check_compile_ledger(ledger)
 
   def test_routing_is_action_invariant(self, tiny_predictor):
     """A request's action depends on (image, seed) only: the routed
