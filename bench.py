@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 12
+ROUND = 13
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -1007,16 +1007,18 @@ def _bench_fleet_compact():
 
 
 def _bench_obs_compact():
-  """Observability block for the bench detail (ISSUE 11).
+  """Observability block for the bench detail (ISSUE 11 + 12).
 
-  The committed chipless artifact (OBS_r12.json) carries the full
+  The committed chipless artifact (OBS_r13.json) carries the full
   protocol on the 8-virtual-device mesh, where estimated_mfu is
   honestly null (no CPU peak model). This block is the
   driver-refreshable real-chip counterpart: a reduced run of the same
-  three phases (fused replay attribution, host-loop stage spans,
-  routed serve window + injected breach) on the window's real devices,
-  where the per-executable estimated-MFU column becomes a measured
-  number against the chip's known peak. Same schema as the artifact.
+  phases (fused replay attribution, host-loop stage spans, routed
+  serve window + injected breach, watchdog controls, the aggregator
+  self-check whose hosts_merged/stall counts feed the round-13 compact
+  keys) on the window's real devices, where the per-executable
+  estimated-MFU column becomes a measured number against the chip's
+  known peak. Same schema as the artifact.
   """
   from tensor2robot_tpu.obs.obs_bench import measure_obs
   return measure_obs(replay_steps=40, host_steps=12,
@@ -1286,6 +1288,15 @@ def main() -> None:
            for row in (obs.get("replay", {}).get("attribution", {})
                        .get("executables") or [])
            if row.get("name") == "anakin_step"), None),
+      # Fleet-obs sentinels (ISSUE 12): how many per-process streams
+      # the obs block's aggregator pass merged, and how many watchdog
+      # stalls its injected-stall control raised (exactly 1 when the
+      # watchdog works: the injection fires, the healthy control stays
+      # silent). Null-safe under outage/error like every compact key.
+      "fleetobs_hosts_merged": obs.get("fleetobs", {}).get(
+          "hosts_merged"),
+      "watchdog_stalls": obs.get("watchdog", {}).get(
+          "injected_stall", {}).get("events"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,
   }))

@@ -51,8 +51,20 @@
 #                                over the replay-smoke protocol, the
 #                                Chrome-trace stage coverage, and the
 #                                injected-SLO-breach flight-recorder
-#                                dump; normally builder-committed and
-#                                skipped)
+#                                dump; since r13 also the watchdog
+#                                controls and the aggregator self-
+#                                check; normally builder-committed
+#                                and skipped)
+#   FLEETOBS_r0N.json            bin/obs_aggregate --smoke (CHIPLESS
+#                                backstop too — ISSUE 12: >= 2 real
+#                                subprocess serve loops on 8-virtual-
+#                                device meshes against one shared
+#                                logdir, merged into one fleet view
+#                                with correlation-linked request
+#                                timelines, the cross-host SLO rollup,
+#                                and the watchdog stall/negative
+#                                controls; normally builder-committed
+#                                and skipped)
 #   BENCH_DETAIL_r0N.json        bench.py (orchestrator; also emits the
 #                                compact line, saved to BENCH_builder_r0N.json)
 #   SERVING_r0N.json             bin/bench_serving single-robot + --fleet lines
@@ -184,6 +196,23 @@ else
   done
   run_stage "OBS_${RTAG}.json" 1800 sh -c '
     python -m tensor2robot_tpu.obs.obs_bench --smoke \
+      --out "$STAGE_TMP"'
+fi
+# Fifth chipless backstop (ISSUE 12): the fleet-observability merge —
+# >= 2 real subprocess loops against one shared logdir, aggregated
+# into the FLEETOBS view (correlation timelines, SLO rollup, watchdog
+# controls). Same tmp→mv atomicity and pytest deferral rules (worker
+# step rates and stall deadlines are timing measurements).
+if [ -s "FLEETOBS_${RTAG}.json" ]; then
+  log "skip FLEETOBS_${RTAG}.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring fleetobs backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "FLEETOBS_${RTAG}.json" 1800 sh -c '
+    python -m tensor2robot_tpu.bin.obs_aggregate --smoke \
       --out "$STAGE_TMP"'
 fi
 while [ "$(date +%s)" -lt "$deadline" ]; do

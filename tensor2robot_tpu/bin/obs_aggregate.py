@@ -1,0 +1,27 @@
+"""Fleet observability aggregation CLI: the bin/ face of obs/aggregate.
+
+    # merge one fleet logdir (N processes' metrics.jsonl / registry
+    # snapshots / Chrome traces / flightrec dumps) into one view:
+    python -m tensor2robot_tpu.bin.obs_aggregate --logdir DIR --out FLEET.json
+
+    # the committed FLEETOBS_r13 protocol (chipless: spawns >= 2 real
+    # subprocess serve loops on 8-virtual-device CPU meshes against one
+    # shared logdir, runs the watchdog positive/negative controls,
+    # merges, self-checks):
+    python -m tensor2robot_tpu.bin.obs_aggregate --smoke --out FLEETOBS_r13.json
+
+    # reduced tier-1 lane (same structure, shorter windows):
+    python -m tensor2robot_tpu.bin.obs_aggregate --ci
+
+Everything — stream discovery, reservoir-union percentile merging, the
+host-prefixed merged trace with cross-process request flows, the SLO
+rollup and its consistency check, straggler detection against the
+fleet median — lives in obs/aggregate.py; this wrapper exists so the
+fleet merge is discoverable next to the other artifact producers in
+the bin/ surface.
+"""
+
+from tensor2robot_tpu.obs.aggregate import main
+
+if __name__ == "__main__":
+  main()

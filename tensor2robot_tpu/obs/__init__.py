@@ -15,7 +15,24 @@ Four layers, each usable alone, designed to compose:
   measured wall time into per-executable device-time attribution.
 - ``flight_recorder``: a bounded in-memory ring of recent spans/events,
   dumped atomically to ``<logdir>/flightrec-*.json`` on SLO breach,
-  rollout auto-rollback, or an unhandled loop-thread exception.
+  rollout auto-rollback, watchdog stall, or an unhandled loop-thread
+  exception.
+
+Round 13 (ISSUE 12) extends the spine ACROSS processes:
+
+- ``context``: contextvar-carried ``request_id``/``step_id``
+  correlation ids minted at serving ingress, auto-attached to every
+  span, exported as Perfetto flows — one clickable per-request
+  timeline across threads and (via the aggregator) processes.
+- ``aggregate``: the fleet merge — N processes' host/pid-stamped
+  ``metrics.jsonl`` streams, registry snapshots, Chrome traces, and
+  flightrec dumps from one shared logdir into one ``FLEETOBS`` view
+  (reservoir-union percentiles, per-host step rates, SLO rollup,
+  host-prefixed merged trace).
+- ``watchdog``: named heartbeats for every loop thread; a monitor
+  flags stalls (no progress within deadline) with counter → flightrec
+  dump → callback escalation, and ``find_stragglers`` flags fleet
+  members below a fraction of the median step rate.
 
 The Podracer analysis (PAPERS.md, arXiv:2104.06272) and the pjit/TPUv4
 scaling study (arXiv:2204.06514) both justify their architectures with
@@ -23,6 +40,9 @@ exactly this per-executable utilization accounting; the multi-host and
 bf16-CEM directions in ROADMAP.md will be measured through this layer.
 """
 
+from tensor2robot_tpu.obs.aggregate import aggregate_logdir
+from tensor2robot_tpu.obs.context import (bind, current_request_id,
+                                          new_request_id)
 from tensor2robot_tpu.obs.flight_recorder import (FlightRecorder,
                                                   get_recorder)
 from tensor2robot_tpu.obs.ledger import (ExecutableLedger,
@@ -31,16 +51,25 @@ from tensor2robot_tpu.obs.ledger import (ExecutableLedger,
 from tensor2robot_tpu.obs.registry import MetricRegistry, get_registry
 from tensor2robot_tpu.obs.trace import (Tracer, get_tracer,
                                         set_device_annotations, span)
+from tensor2robot_tpu.obs.watchdog import (Watchdog, find_stragglers,
+                                           get_watchdog)
 
 __all__ = [
     "ExecutableLedger",
     "FlightRecorder",
     "MetricRegistry",
     "Tracer",
+    "Watchdog",
+    "aggregate_logdir",
+    "bind",
     "check_compile_ledger",
+    "current_request_id",
+    "find_stragglers",
     "get_recorder",
     "get_registry",
     "get_tracer",
+    "get_watchdog",
+    "new_request_id",
     "peak_flops_for",
     "set_device_annotations",
     "span",

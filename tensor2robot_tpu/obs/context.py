@@ -1,0 +1,140 @@
+"""Request-scoped correlation ids for cross-process causality (ISSUE 12).
+
+A fleet request's life crosses threads and tiers: the client thread
+that enqueued it, the EDF heap it waited in, the dispatcher that
+flushed it, the device the replica dispatched to, sometimes a
+``rollout_mirror`` twin riding the shadow path. Before this module
+those hops produced disconnected spans — "where did request X spend
+its 40ms" required manual timestamp archaeology.
+
+The fix is one process-local identity layer:
+
+- ``new_request_id()`` mints a globally unique id at *ingress* —
+  ``FleetServer.submit`` / ``FleetRouter.submit`` / a bare
+  ``MicroBatcher.submit`` — stamped with host + pid so ids stay
+  distinct across the processes a fleet logdir merges.
+- ``bind(request_id=..., step_id=...)`` carries the identity in a
+  ``contextvars.ContextVar``; every ``obs.trace.span`` completed while
+  bound automatically carries the bound ids as span attrs (explicit
+  span attrs win on collision).
+- The batcher threads the id onto its pending-request record, so the
+  dispatcher side (a DIFFERENT thread — contextvars do not cross) can
+  re-bind it around the flush: ``serve/flush`` spans carry the whole
+  batch's ids as a comma-joined ``request_ids`` attr, and anything the
+  flush calls into (the replica's device dispatch) inherits them.
+
+``Tracer.export_chrome_trace`` turns the ids into Perfetto *flow
+events*: every request id seen on >= 2 spans becomes one clickable
+arrow chain linking enqueue → flush → dispatch across thread lanes.
+The flight recorder's dumps carry the triggering request's id, so a
+shed's post-mortem names the exact request that breached.
+
+Everything here is host-side and allocation-light: a bind is one
+ContextVar.set, an id is a counter increment — safe on the serving
+hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import os
+import socket
+from typing import Dict, Iterable, Optional
+
+# One ContextVar per correlation field. `request_id` identifies one
+# client request end to end; `step_id` identifies one loop step (the
+# replay loop binds its optimizer step so learner-side spans join the
+# same timeline view); `request_ids` is the batch-side form a flush
+# binds for the spans that serve MANY requests at once.
+_REQUEST_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "t2r_request_id", default=None)
+_REQUEST_IDS: contextvars.ContextVar = contextvars.ContextVar(
+    "t2r_request_ids", default=None)
+_STEP_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "t2r_step_id", default=None)
+
+_SEQ = itertools.count()
+# Short stable host tag; pid is read per-mint so a fork cannot reuse
+# the parent's id space.
+_HOST = socket.gethostname().split(".", 1)[0]
+
+
+def new_request_id() -> str:
+  """Mints one fleet-unique request id: ``<host>-<pid>-<seq>``."""
+  return f"{_HOST}-{os.getpid()}-{next(_SEQ)}"
+
+
+def current_request_id() -> Optional[str]:
+  return _REQUEST_ID.get()
+
+
+def current_step_id() -> Optional[int]:
+  return _STEP_ID.get()
+
+
+def context_attrs() -> Dict[str, object]:
+  """The currently bound correlation attrs (empty dict when unbound).
+
+  This is the tracer's per-span read: two ContextVar.get calls on the
+  hot path, dict construction only when something is actually bound.
+  """
+  request_id = _REQUEST_ID.get()
+  request_ids = _REQUEST_IDS.get()
+  step_id = _STEP_ID.get()
+  if request_id is None and request_ids is None and step_id is None:
+    return {}
+  attrs: Dict[str, object] = {}
+  if request_id is not None:
+    attrs["request_id"] = request_id
+  if request_ids is not None:
+    attrs["request_ids"] = request_ids
+  if step_id is not None:
+    attrs["step_id"] = step_id
+  return attrs
+
+
+@contextlib.contextmanager
+def bind(request_id: Optional[str] = None,
+         request_ids: Optional[str] = None,
+         step_id: Optional[int] = None):
+  """Binds correlation ids for the duration of the ``with`` block.
+
+  Only the fields given are (re)bound; the rest keep their current
+  values, so a nested bind of ``step_id`` does not drop an enclosing
+  ``request_id``. ``request_ids`` is the comma-joined batch form the
+  dispatcher binds around a flush.
+  """
+  tokens = []
+  try:
+    if request_id is not None:
+      tokens.append((_REQUEST_ID, _REQUEST_ID.set(request_id)))
+    if request_ids is not None:
+      tokens.append((_REQUEST_IDS, _REQUEST_IDS.set(request_ids)))
+    if step_id is not None:
+      tokens.append((_STEP_ID, _STEP_ID.set(int(step_id))))
+    yield
+  finally:
+    for var, token in reversed(tokens):
+      var.reset(token)
+
+
+def join_ids(ids: Iterable[Optional[str]]) -> str:
+  """The canonical batch encoding: comma-joined, Nones dropped (span
+  attrs must stay JSON scalars; the trace exporter splits on ",")."""
+  return ",".join(i for i in ids if i)
+
+
+def span_request_ids(record: dict) -> Iterable[str]:
+  """Every request id a completed span record carries — the single
+  decoder for the ``request_id`` / ``request_ids`` attr convention
+  (used by the Chrome-trace flow linker and the fleet aggregator)."""
+  single = record.get("request_id")
+  if single:
+    yield single
+  many = record.get("request_ids")
+  if many:
+    for part in str(many).split(","):
+      if part and part != single:
+        yield part

@@ -24,6 +24,8 @@ Dump schema (``docs/ARTIFACTS.md`` round-12 section)::
 
     {"schema": "t2r-flightrec-1", "host": ..., "pid": ...,
      "reason": ..., "dumped_at": <unix s>, "events_total": N,
+     "trigger": {<the triggering event's fields>},   # when triggered
+     "request_id": ...,   # when the trigger named one (ISSUE 12)
      "events": [{"t_s": ..., "wall_time": ..., "kind":
                  "span"|"event"|"trigger", "name": ..., ...}, ...]}
 """
@@ -31,6 +33,7 @@ Dump schema (``docs/ARTIFACTS.md`` round-12 section)::
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import socket
@@ -38,6 +41,8 @@ import threading
 import time
 from collections import deque
 from typing import Optional
+
+_log = logging.getLogger(__name__)
 
 SCHEMA = "t2r-flightrec-1"
 
@@ -63,8 +68,22 @@ class FlightRecorder:
                 min_dump_interval_s: Optional[float] = None) -> None:
     """Late wiring for the process-default recorder: components record
     from construction; dumps start once someone (the owning loop/bench)
-    names a directory."""
+    names a directory.
+
+    Repointing an already-configured recorder at a DIFFERENT directory
+    logs a warning: on the shared process recorder that is
+    last-configured-wins — the previous owner's triggers now dump into
+    the new owner's logdir. Two loops in one process should each own a
+    ``FlightRecorder`` instance instead (ReplayTrainLoop does since
+    round 13) and leave the process recorder to the serving tier.
+    """
     if dump_dir is not None:
+      if self.dump_dir is not None and self.dump_dir != dump_dir:
+        _log.warning(
+            "flight recorder dump_dir repointed %r -> %r "
+            "(last-configured-wins on a shared recorder; use "
+            "per-component FlightRecorder instances to keep dumps "
+            "apart)", self.dump_dir, dump_dir)
       self.dump_dir = dump_dir
     if min_dump_interval_s is not None:
       self.min_dump_interval_s = min_dump_interval_s
@@ -102,16 +121,25 @@ class FlightRecorder:
   def attach(self, tracer) -> None:
     tracer.add_listener(self.record_span)
 
+  def detach(self, tracer) -> None:
+    """Unsubscribes from the tracer (idempotent). Per-loop recorder
+    instances attach for their run and MUST detach after it, or every
+    later span in the process pays a listener call per dead loop."""
+    tracer.remove_listener(self.record_span)
+
   def events(self) -> list:
     with self._lock:
       return list(self._events)
 
   # -- dumping -------------------------------------------------------------
 
-  def dump(self, reason: str, dump_dir: Optional[str] = None
-           ) -> Optional[str]:
+  def dump(self, reason: str, dump_dir: Optional[str] = None,
+           context: Optional[dict] = None) -> Optional[str]:
     """Writes the ring atomically (tmp → rename); returns the path, or
-    None when no dump directory is configured."""
+    None when no dump directory is configured. ``context`` (the
+    triggering event's fields) lands top-level as ``trigger`` — a
+    breach dump names its ``request_id`` without the reader fishing
+    through the ring."""
     directory = dump_dir or self.dump_dir
     if directory is None:
       return None
@@ -131,6 +159,13 @@ class FlightRecorder:
         "events_total": events_total,
         "events": events,
     }
+    if context:
+      payload["trigger"] = {
+          key: value if isinstance(
+              value, (int, float, str, bool, type(None))) else repr(value)
+          for key, value in context.items()}
+      if "request_id" in context:
+        payload["request_id"] = payload["trigger"]["request_id"]
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
       # default=repr as a belt: a post-mortem writer must not itself
@@ -156,7 +191,7 @@ class FlightRecorder:
         self.dumps_suppressed += 1
         return None
       self._last_dump_at = now
-    return self.dump(reason)
+    return self.dump(reason, context=fields)
 
 
 _DEFAULT: Optional[FlightRecorder] = None

@@ -1,7 +1,11 @@
-"""Obs bench: the observability spine exercised end to end — OBS_r12.
+"""Obs bench: the observability spine exercised end to end — OBS_r13.
 
-The ISSUE 11 acceptance instrument. One run drives all four obs layers
-across the whole production loop and emits ONE JSON line:
+The ISSUE 11 acceptance instrument, extended for round 13 (ISSUE 12):
+after the three original phases it exports the process registry
+snapshot, runs the watchdog positive/negative controls, then runs
+``obs/aggregate.py`` over its OWN phase artifacts and asserts the
+merged view is self-consistent — so the committed artifact validates
+the aggregator, not just the spine. One run, ONE JSON line:
 
 1. **replay** — the replay-smoke protocol (the r10 shape:
    ``run_qtopt_replay --smoke --anakin --mesh DP,1`` built via the
@@ -25,6 +29,18 @@ across the whole production loop and emits ONE JSON line:
 4. **trace / registry / flightrec** — the Chrome-trace export (valid
    JSON, per-stage span counts), the process registry snapshot, and
    the breach dump's path + schema.
+5. **watchdog** (round 13) — an injected stall (a busy component that
+   never progresses) must produce a schema-valid ``watchdog_stall``
+   flight-recorder dump, and a healthy beating component must produce
+   ZERO events (the false-positive negative control; deadlines scale
+   with the cpu_count >= 4 gating convention).
+6. **fleetobs** (round 13) — ``aggregate_logdir`` over this run's own
+   logdir: the merged view's shed rollup must be consistent (global
+   counters == per-class sums across sources), the breach request's
+   correlation timeline must link enqueue → flush → dispatch in the
+   merged trace, and the hosts_merged / stall counts land in bench.py's
+   compact keys. The MULTI-process version of this merge is the
+   separate committed FLEETOBS artifact (bin/obs_aggregate --smoke).
 
 HONESTY CAVEAT (mirrors MULTICHIP/FLEET): chipless, the mesh is 8
 virtual CPU devices sharing this host's cores — `estimated_mfu` is
@@ -212,7 +228,9 @@ def measure_obs(
     seed: int = 0,
     logdir: Optional[str] = None,
 ) -> Dict:
-  """Runs the three phases; returns the OBS_r12 artifact dict."""
+  """Runs the full protocol (replay/host/serve phases + the registry
+  export, watchdog controls, and aggregator self-check); returns the
+  OBS_r13 artifact dict."""
   import jax
 
   from tensor2robot_tpu.obs import trace as trace_lib
@@ -243,11 +261,44 @@ def measure_obs(
       for key, value in registry_lib.get_registry().snapshot().items()
       if not key.endswith(("/p90", "/max", "/mean"))}
 
+  # Round 13: watchdog controls + the aggregator run over THIS run's
+  # own artifacts (metrics.jsonl from the replay/host phases, the
+  # registry snapshot exported here, the Chrome trace, the breach +
+  # watchdog flightrec dumps) — so the committed artifact proves the
+  # MERGE, not just the spine. The multi-process form of the same
+  # merge is the separate FLEETOBS artifact (bin/obs_aggregate).
+  from tensor2robot_tpu.obs import aggregate as aggregate_lib
+  registry_lib.get_registry().export_snapshot(
+      os.path.join(logdir, "registry.json"))
+  watchdog = aggregate_lib.watchdog_controls(logdir, ci=True)
+  fleet = aggregate_lib.aggregate_logdir(logdir)
+  assert fleet["slo"]["consistent"], fleet["slo"]
+  assert fleet["slo"]["shed_total"] >= serve["breach"]["shed"], (
+      fleet["slo"], serve["breach"])
+  assert fleet["trace"]["linked_serve_timelines"] >= 1, fleet["trace"]
+  assert watchdog["injected_stall"]["ok"], watchdog
+  assert watchdog["healthy_control"]["ok"], watchdog
+  fleetobs = {
+      "hosts_merged": fleet["hosts_merged"],
+      "inputs": fleet["inputs"],
+      "slo": fleet["slo"],
+      "trace": {key: fleet["trace"][key]
+                for key in ("file", "events", "request_ids_seen",
+                            "flows_linked", "linked_serve_timelines",
+                            "example_timeline")},
+      "flightrec_reasons": fleet["flightrec"]["reasons"],
+      "stragglers": fleet["stragglers"],
+      "consistent": fleet["slo"]["consistent"],
+  }
+
   return {
-      "round": 12,
+      "round": 13,
       "metric": ("observability spine: per-executable device-time "
                  "attribution + spans + metric registry + flight "
-                 "recorder across the production loop"),
+                 "recorder across the production loop, plus (r13) "
+                 "correlation-linked request timelines, the fleet "
+                 "aggregator self-check, and the stall watchdog "
+                 "controls"),
       "device_kind": device_kind,
       "virtual_mesh": device_kind.lower() == "cpu",
       "devices": len(devices),
@@ -262,6 +313,8 @@ def measure_obs(
           "stage_counts": stage_counts,
       },
       "registry": registry_snapshot,
+      "watchdog": watchdog,
+      "fleetobs": fleetobs,
       "flightrec_schema": "t2r-flightrec-1",
       "note": (
           "Attribution shares are host wall-clock dispatch windows "
@@ -282,14 +335,14 @@ def measure_obs(
 def main(argv=None) -> None:
   """CLI: ONE JSON line (the bench contract). --smoke bootstraps the
   8-virtual-device CPU mesh (re-exec with the canonical env) and runs
-  the committed OBS_r12 protocol; --ci is the reduced tier-1 lane."""
+  the committed OBS_r13 protocol; --ci is the reduced tier-1 lane."""
   import argparse
   import sys
 
   parser = argparse.ArgumentParser(description=__doc__)
   parser.add_argument("--smoke", action="store_true",
-                      help="chipless committed-artifact lane: 8 "
-                           "virtual CPU devices, full protocol")
+                      help="chipless committed-artifact lane (OBS_r13): "
+                           "8 virtual CPU devices, full protocol")
   parser.add_argument("--ci", action="store_true",
                       help="reduced chipless lane for tier-1 tests")
   parser.add_argument("--seed", type=int, default=0)

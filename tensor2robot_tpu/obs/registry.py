@@ -19,9 +19,16 @@ nearest-rank p50/p99 snapshots — the same percentile convention
 from __future__ import annotations
 
 import collections
+import json
 import math
+import os
+import socket
 import threading
 from typing import Dict, Iterable, Mapping, Optional
+
+# Schema tag for on-disk registry snapshots (the fleet aggregator's
+# input format — obs/aggregate.py merges one per process).
+SNAPSHOT_SCHEMA = "t2r-registry-1"
 
 
 def _nearest_rank(ordered, pct: float) -> float:
@@ -86,6 +93,20 @@ class Histogram:
     with self._lock:
       self._samples.append(float(value))
       self._count += 1
+
+  def samples(self) -> list:
+    """The retained reservoir (newest max_samples). This is what the
+    fleet aggregator unions across processes so the merged p99 comes
+    from ONE nearest-rank pass over real samples instead of averaging
+    per-process percentiles (which has no statistical meaning)."""
+    with self._lock:
+      return list(self._samples)
+
+  @property
+  def count(self) -> int:
+    """Samples ever recorded (the reservoir may have dropped oldest)."""
+    with self._lock:
+      return self._count
 
   def snapshot(self, digits: int = 4) -> Dict[str, float]:
     with self._lock:
@@ -165,6 +186,43 @@ class MetricRegistry:
         if value is not None:
           out[name] = value
     return out
+
+  def export_snapshot(self, path: str) -> str:
+    """Writes this process's full registry state for the fleet merge.
+
+    Atomic (tmp → mv), host/pid-stamped, schema-versioned. Counters
+    and gauges export their values; histograms export their RAW
+    reservoir (plus the true count), because cross-process percentile
+    merging needs samples, not percentiles — obs/aggregate.py unions
+    the reservoirs and runs the one nearest-rank pass.
+    """
+    with self._lock:
+      metrics = dict(self._metrics)
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
+    histograms: Dict[str, dict] = {}
+    for name, metric in sorted(metrics.items()):
+      if isinstance(metric, Counter):
+        counters[name] = metric.value
+      elif isinstance(metric, Gauge):
+        if metric.value is not None:
+          gauges[name] = metric.value
+      elif isinstance(metric, Histogram):
+        histograms[name] = {"count": metric.count,
+                            "samples": metric.samples()}
+    payload = {
+        "schema": SNAPSHOT_SCHEMA,
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+      json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
 
   def flush_to(self, metric_writer, step: int,
                names: Optional[Iterable[str]] = None,

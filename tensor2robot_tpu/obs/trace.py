@@ -20,6 +20,13 @@ is two ``perf_counter`` reads and one deque append.
 Listeners (``add_listener``) receive every completed span dict — the
 flight recorder subscribes so the last N spans are always available for
 a post-mortem dump.
+
+Correlation (ISSUE 12): spans completed while ``obs.context`` has a
+bound ``request_id``/``step_id`` carry those ids as attrs
+automatically, and ``export_chrome_trace`` links every request id seen
+on >= 2 spans into one Perfetto *flow* (arrow chain across thread
+lanes) — the per-request timeline the fleet aggregator merges across
+processes.
 """
 
 from __future__ import annotations
@@ -33,6 +40,8 @@ import socket
 import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+from tensor2robot_tpu.obs import context as context_lib
 
 _log = logging.getLogger(__name__)
 
@@ -88,7 +97,10 @@ class Tracer:
       }
       if parent is not None:
         record["parent"] = parent
-      if attrs:
+      context_attrs = context_lib.context_attrs()
+      if context_attrs:
+        record.update(context_attrs)
+      if attrs:  # explicit attrs win over inherited context attrs
         record.update(attrs)
       with self._lock:
         self._spans.append(record)
@@ -105,6 +117,13 @@ class Tracer:
     with self._lock:
       if listener not in self._listeners:
         self._listeners.append(listener)
+
+  def remove_listener(self, listener: Callable[[dict], None]) -> None:
+    """Unsubscribes a listener; unknown listeners are a no-op (a
+    recorder detaching twice must not raise in a finally block)."""
+    with self._lock:
+      if listener in self._listeners:
+        self._listeners.remove(listener)
 
   # -- readout -------------------------------------------------------------
 
@@ -136,14 +155,26 @@ class Tracer:
 
     Loads directly in Perfetto / chrome://tracing; complete events
     ("ph": "X") with microsecond timestamps relative to this tracer's
-    epoch, one row per Python thread.
+    epoch, one row per Python thread. Every request id carried by
+    >= 2 spans (the ``request_id``/``request_ids`` attr convention,
+    obs/context.py) additionally becomes one flow — "s"/"t"/"f"
+    arrow events with a shared id — so a request's enqueue → flush →
+    dispatch hops across threads read as one clickable timeline.
     """
     retained = self.spans()
     pid = os.getpid()
+    # Wall-clock anchor for the fleet merge: span timestamps are
+    # relative to THIS tracer's construction-time perf_counter epoch,
+    # which is meaningless across processes — epoch_wall_s is that
+    # epoch on the shared wall clock, so obs/aggregate.py can offset
+    # each process's lane onto one comparable timeline.
+    epoch_wall_s = time.time() - (time.perf_counter() - self._epoch)
     events = [{
         "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-        "args": {"name": f"{socket.gethostname()}:{pid}"},
+        "args": {"name": f"{socket.gethostname()}:{pid}",
+                 "epoch_wall_s": round(epoch_wall_s, 6)},
     }]
+    by_request: Dict[str, list] = {}
     for record in retained:
       args = {key: value for key, value in record.items()
               if key not in ("name", "ts_s", "dur_s", "tid")}
@@ -156,12 +187,58 @@ class Tracer:
           "tid": record["tid"],
           "args": args,
       })
+      for request_id in context_lib.span_request_ids(record):
+        by_request.setdefault(request_id, []).append(record)
+    events.extend(request_flow_events(by_request, pid))
     payload = {"traceEvents": events, "displayTimeUnit": "ms"}
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
       json.dump(payload, f)
     os.replace(tmp, path)
     return path
+
+
+def request_flow_events(by_request: Dict[str, list], pid: int,
+                        flow_ids: Optional[Dict[str, int]] = None) -> list:
+  """Perfetto flow events linking each request's spans in time order.
+
+  ``by_request`` maps request id → span records (the tracer's dict
+  shape); ids with fewer than two spans emit nothing (an arrow needs
+  two ends). ``flow_ids`` lets the fleet aggregator keep flow ids
+  stable while merging several processes' traces — same request id in
+  two files, one arrow chain across both. A record carrying its own
+  ``pid`` (the aggregator's remapped per-process lanes) overrides the
+  default ``pid``.
+  """
+  flow_ids = {} if flow_ids is None else flow_ids
+  events = []
+  for request_id, records in sorted(by_request.items()):
+    if len(records) < 2:
+      continue
+    flow_id = flow_ids.setdefault(request_id, len(flow_ids) + 1)
+    ordered = sorted(records, key=lambda r: r["ts_s"])
+    for index, record in enumerate(ordered):
+      if index == 0:
+        phase = "s"
+      elif index == len(ordered) - 1:
+        phase = "f"
+      else:
+        phase = "t"
+      event = {
+          "name": f"request {request_id}",
+          "cat": "request",
+          "ph": phase,
+          "id": flow_id,
+          # Bind the arrow end INSIDE its slice (not at the edge) so
+          # Perfetto attaches it to the enclosing span unambiguously.
+          "ts": round((record["ts_s"] + record["dur_s"] / 2) * 1e6, 3),
+          "pid": record.get("pid", pid),
+          "tid": record["tid"],
+      }
+      if phase == "f":
+        event["bp"] = "e"
+      events.append(event)
+  return events
 
 
 _DEFAULT: Optional[Tracer] = None

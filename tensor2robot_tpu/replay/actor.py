@@ -59,6 +59,7 @@ import numpy as np
 
 from tensor2robot_tpu.obs import flight_recorder as flight_lib
 from tensor2robot_tpu.obs import trace as trace_lib
+from tensor2robot_tpu.obs import watchdog as watchdog_lib
 from tensor2robot_tpu.replay.ingest import TransitionQueue
 
 
@@ -76,11 +77,18 @@ class VectorActor:
                num_envs: int = 32, max_attempts: int = 4,
                seed: int = 0, grasp_radius: float = 0.35,
                exploration_epsilon: float = 0.2,
-               scripted_fraction: float = 0.25):
+               scripted_fraction: float = 0.25,
+               flight_recorder=None, watchdog=None):
     from tensor2robot_tpu.research.qtopt.synthetic_grasping import (
         VectorGraspEnv)
     self._policy = policy
     self._queue = queue
+    # Owner-injectable observability (CollectorWorker contract): the
+    # loop passes ITS recorder so an actor-death dump lands beside the
+    # run's metrics, and ITS watchdog so the owner's monitor covers
+    # acting liveness — defaults are the process singletons.
+    self._recorder = flight_recorder or flight_lib.get_recorder()
+    self._watchdog = watchdog or watchdog_lib.get_watchdog()
     # Exploration mix, QT-Opt parity — the same recipe, draw order, and
     # rng stream seeding as CollectorWorker (see its inline rationale:
     # scripted successes are what keep a cold critic off the base
@@ -137,15 +145,22 @@ class VectorActor:
     return seed
 
   def _run(self) -> None:
+    # Liveness heartbeat (ISSUE 12): one beat per lockstep control
+    # step; unregistered when the thread exits so a finished actor
+    # never reads as a stalled one.
+    heartbeat = self._watchdog.register("act/vector_actor")
     try:
       while not self._stop.is_set():
         start = time.perf_counter()
         self.step_once()
         self.busy_seconds += time.perf_counter() - start
+        heartbeat.beat()
     except BaseException as e:  # noqa: BLE001 — surfaced via stop()
       self.errors.append(e)
-      flight_lib.get_recorder().trigger(
+      self._recorder.trigger(
           "actor_thread_exception", error=f"{type(e).__name__}: {e}")
+    finally:
+      self._watchdog.unregister(heartbeat)
 
   def step_once(self) -> None:
     """One batched control step: act → step → enqueue, all fleet-wide.
@@ -200,7 +215,8 @@ class ActorFleet:
                grasp_radius: float = 0.35,
                exploration_epsilon: float = 0.2,
                scripted_fraction: float = 0.25,
-               num_actors: int = 1):
+               num_actors: int = 1,
+               flight_recorder=None, watchdog=None):
     if num_actors < 1 or total_envs % num_actors:
       raise ValueError(
           f"total_envs {total_envs} must split evenly over "
@@ -211,7 +227,8 @@ class ActorFleet:
                     max_attempts=max_attempts, seed=seed + i,
                     grasp_radius=grasp_radius,
                     exploration_epsilon=exploration_epsilon,
-                    scripted_fraction=scripted_fraction)
+                    scripted_fraction=scripted_fraction,
+                    flight_recorder=flight_recorder, watchdog=watchdog)
         for i in range(num_actors)
     ]
 

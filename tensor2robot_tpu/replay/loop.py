@@ -53,6 +53,7 @@ from tensor2robot_tpu.obs import flight_recorder as flight_lib
 from tensor2robot_tpu.obs import ledger as obs_ledger
 from tensor2robot_tpu.obs import registry as registry_lib
 from tensor2robot_tpu.obs import trace as trace_lib
+from tensor2robot_tpu.obs import watchdog as watchdog_lib
 from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
 from tensor2robot_tpu.replay.bellman import BellmanUpdater
 from tensor2robot_tpu.replay.ingest import ReplayFeeder, TransitionQueue
@@ -133,12 +134,16 @@ class CollectorWorker:
                seed: int = 0, grasp_radius: float = 0.35,
                exploration_epsilon: float = 0.2,
                scripted_fraction: float = 0.25,
-               flight_recorder=None):
+               flight_recorder=None, watchdog=None):
     from tensor2robot_tpu.research.qtopt.synthetic_grasping import (
         GraspRetryEnv)
     self._policy = policy
     self._queue = queue
     self._recorder = flight_recorder or flight_lib.get_recorder()
+    # Owner-injectable watchdog (same reason as flight_recorder): the
+    # loop's monitor must cover ITS collector threads, not register
+    # them on the never-started process default.
+    self._watchdog = watchdog or watchdog_lib.get_watchdog()
     # Exploration mix, QT-Opt parity: the reference's logs were seeded
     # by SCRIPTED grasps (its real-robot data was majority scripted
     # early on — synthetic_grasping.generate_grasps models the same
@@ -193,15 +198,22 @@ class CollectorWorker:
     return seed
 
   def _run(self) -> None:
+    # Liveness heartbeat (ISSUE 12): one beat per lockstep control
+    # step; unregistered on exit so a cleanly-stopped collector never
+    # reads as stalled.
+    heartbeat = self._watchdog.register("act/collector")
     try:
       while not self._stop.is_set():
         self.step_once()
+        heartbeat.beat()
     except BaseException as e:  # noqa: BLE001 — surfaced via stop()
       self.errors.append(e)
       # Loop-thread death is a flight-recorder trigger: the dump holds
       # the spans/events right before this collector died.
       self._recorder.trigger("collector_thread_exception",
                              error=f"{type(e).__name__}: {e}")
+    finally:
+      self._watchdog.unregister(heartbeat)
 
   def step_once(self) -> None:
     """One lockstep control step across the whole env fleet."""
@@ -351,7 +363,9 @@ class ReplayTrainLoop:
       budgets).
   """
 
-  def __init__(self, config: ReplayLoopConfig, logdir: str, model=None):
+  def __init__(self, config: ReplayLoopConfig, logdir: str, model=None,
+               flight_recorder: Optional[flight_lib.FlightRecorder] = None,
+               watchdog: Optional[watchdog_lib.Watchdog] = None):
     from tensor2robot_tpu.train.trainer import Trainer
     from tensor2robot_tpu.utils.metric_writer import MetricWriter
 
@@ -361,13 +375,19 @@ class ReplayTrainLoop:
     # Observability spine (ISSUE 11): one ExecutableLedger per loop run
     # (every compiled program this loop owns registers + records
     # dispatch time into it — the attribution in the result's `obs`
-    # block), the process registry as the metric namespace, and the
-    # process flight recorder pointed at THIS logdir so an SLO breach /
-    # thread death / loop exception dumps next to the run's metrics.
+    # block) and the process registry as the metric namespace. Since
+    # round 13 each loop owns its OWN FlightRecorder pointed at THIS
+    # logdir (subscribed to the process tracer only for the duration
+    # of run()) — the old repoint-the-process-recorder wiring was
+    # last-configured-wins, so two loops in one process silently stole
+    # each other's dumps. The watchdog (default: the process one,
+    # monitor not running unless the owner starts it) receives
+    # learner/feeder heartbeats from every loop path.
     self.obs_ledger = obs_ledger.ExecutableLedger()
     self.registry = registry_lib.get_registry()
-    self.recorder = flight_lib.get_recorder()
-    self.recorder.configure(dump_dir=logdir)
+    self.recorder = flight_recorder or flight_lib.FlightRecorder(
+        dump_dir=logdir)
+    self.watchdog = watchdog or watchdog_lib.get_watchdog()
     mesh = None
     if config.mesh_dp:
       import jax
@@ -546,7 +566,8 @@ class ReplayTrainLoop:
           max_attempts=c.max_attempts, seed=c.seed,
           grasp_radius=c.grasp_radius,
           exploration_epsilon=c.exploration_epsilon,
-          scripted_fraction=c.scripted_fraction)
+          scripted_fraction=c.scripted_fraction,
+          flight_recorder=self.recorder, watchdog=self.watchdog)
       self._collectors = self._fleet.actors
       self._fleet.start()
       return
@@ -557,7 +578,8 @@ class ReplayTrainLoop:
                         seed=c.seed + i, grasp_radius=c.grasp_radius,
                         exploration_epsilon=c.exploration_epsilon,
                         scripted_fraction=c.scripted_fraction,
-                        flight_recorder=self.recorder)
+                        flight_recorder=self.recorder,
+                        watchdog=self.watchdog)
         for i in range(c.num_collectors)
     ]
     for collector in self._collectors:
@@ -653,6 +675,17 @@ class ReplayTrainLoop:
   def run(self, num_steps: int) -> Dict:
     """Runs the closed loop for `num_steps` optimizer steps."""
     self._run_started = time.perf_counter()
+    # The loop's recorder rides the process tracer only while the run
+    # is live — attach here, detach in the finally, so a process that
+    # constructs many loops (benches, tests) doesn't accumulate dead
+    # listeners paying a callback per span forever.
+    self.recorder.attach(trace_lib.get_tracer())
+    # Liveness heartbeats (ISSUE 12): the learner beats once per
+    # optimizer-step boundary (per dispatch on the fused paths), the
+    # feeder once per drain. Registered per run, unregistered on the
+    # way out — a finished loop must never read as a stalled one.
+    self._learner_hb = self.watchdog.register("replay/learner")
+    self._feeder_hb = self.watchdog.register("replay/feeder")
     try:
       if self.config.anakin:
         return self._run_anakin(num_steps)
@@ -665,6 +698,10 @@ class ReplayTrainLoop:
       self.recorder.trigger("replay_loop_exception",
                             error=f"{type(e).__name__}: {e}")
       raise
+    finally:
+      self.watchdog.unregister(self._learner_hb)
+      self.watchdog.unregister(self._feeder_hb)
+      self.recorder.detach(trace_lib.get_tracer())
 
   def _run_host(self, num_steps: int) -> Dict:
     """The PR 2 host-path loop (threaded collectors + per-step host
@@ -704,6 +741,7 @@ class ReplayTrainLoop:
       for step in range(1, num_steps + 1):
         with trace_lib.span("extend/drain"):
           self.feeder.drain()
+        self._feeder_hb.beat()
         batch, info = self.buffer.sample()
         targets, q_next = updater.compute_targets(batch)
         features = {"image": np.asarray(batch["image"]),
@@ -725,6 +763,7 @@ class ReplayTrainLoop:
           state, metrics = train_step(state, *sharded)
           self.obs_ledger.record_dispatch(
               "train_step", time.perf_counter() - dispatch_start)
+        self._learner_hb.beat()
         # Valid until the NEXT train_step donates these buffers away;
         # every read below happens before that.
         online = state.variables(use_ema=True)
@@ -834,7 +873,9 @@ class ReplayTrainLoop:
       for outer in range(1, num_outer + 1):
         with trace_lib.span("extend/drain"):
           self.feeder.drain()
+        self._feeder_hb.beat()
         state, metrics = learner.step(state)
+        self._learner_hb.beat()
         step = outer * k
         self._profile_step(profile_hook, step)
         # Cadences count OPTIMIZER steps: an event fires when its
@@ -959,6 +1000,7 @@ class ReplayTrainLoop:
               f"steps after {dispatches} dispatches "
               f"(min_fill={c.min_fill}, buffer size={self.buffer.size})")
         state, metrics = loop.step(state)
+        self._learner_hb.beat()
         dispatches += 1
         step = loop.trained_steps
         self._profile_step(profile_hook, step)
@@ -1019,6 +1061,7 @@ class ReplayTrainLoop:
     deadline = time.monotonic() + self.config.min_fill_timeout_s
     while not self.feeder.ready():
       self.feeder.drain()
+      self._feeder_hb.beat()
       for collector in self._collectors:
         if collector.errors:
           raise RuntimeError("collector died during warm-up") from (

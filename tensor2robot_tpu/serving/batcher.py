@@ -35,20 +35,29 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Optional, Sequence
 
+from tensor2robot_tpu.obs import context as context_lib
 from tensor2robot_tpu.obs import flight_recorder as flight_lib
 from tensor2robot_tpu.obs import trace as trace_lib
+from tensor2robot_tpu.obs import watchdog as watchdog_lib
 from tensor2robot_tpu.serving.slo import RequestShed, SLOClass
 from tensor2robot_tpu.serving.stats import ServingStats
 
 
 class _Request:
   __slots__ = ("item", "future", "enqueued_at", "deadline", "flush_at",
-               "slo", "shed")
+               "slo", "shed", "request_id")
 
   def __init__(self, item: Any, slo: SLOClass,
-               deadline_at: Optional[float], margin_s: float):
+               deadline_at: Optional[float], margin_s: float,
+               request_id: Optional[str] = None):
     self.item = item
     self.future: Future = Future()
+    # Correlation (ISSUE 12): the id every span/dump this request
+    # touches will carry. Inherit the caller's bound id (the router's
+    # ingress bind); a bare batcher submit mints its own so direct
+    # clients get timelines too.
+    self.request_id = (request_id or context_lib.current_request_id()
+                       or context_lib.new_request_id())
     self.enqueued_at = time.perf_counter()
     # `deadline` is the CLIENT's latency budget (expiry/shed basis);
     # `flush_at` is when the dispatcher must ship a partial batch so
@@ -93,7 +102,8 @@ class MicroBatcher:
                bucket_for: Optional[Callable[[int], int]] = None,
                max_queue: Optional[int] = None,
                dispatch_margin_ms: float = 0.0,
-               flight_recorder: Optional[flight_lib.FlightRecorder] = None):
+               flight_recorder: Optional[flight_lib.FlightRecorder] = None,
+               watchdog: Optional[watchdog_lib.Watchdog] = None):
     """See class docstring. `dispatch_margin_ms` budgets the flush's own
     cost: a partial batch ships `margin` BEFORE its head's deadline, so
     a class's p99 can actually sit inside its budget (set it to a
@@ -101,7 +111,11 @@ class MicroBatcher:
     behavior). `flight_recorder` (default: the process recorder)
     receives every shed as an SLO-breach trigger and the dispatcher's
     unhandled exceptions — dumps fire only once a dump_dir is
-    configured on it."""
+    configured on it. `watchdog` (default: the process watchdog) gets a
+    per-instance dispatcher heartbeat: beats per flush, idle while the
+    queue is empty, so a dispatcher stuck with pending work (a wedged
+    batch_fn, a hold that outlived its test) is flagged as a stall —
+    but only once the owning deployment STARTS the watchdog monitor."""
     if max_batch < 1:
       raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     if deadline_ms < 0:
@@ -119,6 +133,8 @@ class MicroBatcher:
     self._bucket_for = bucket_for or (lambda n: n)
     self._max_queue = max_queue
     self._recorder = flight_recorder or flight_lib.get_recorder()
+    self._watchdog = watchdog or watchdog_lib.get_watchdog()
+    self._heartbeat: Optional[watchdog_lib.Heartbeat] = None
     # Min-heap of (deadline, seq, request); shed entries stay in the
     # heap with request.shed=True and are skipped on pop (lazy
     # deletion), _live tracks the real pending count.
@@ -143,6 +159,7 @@ class MicroBatcher:
       if self._running:
         return self
       self._running = True
+    self._heartbeat = self._watchdog.register("serve/batcher")
     self._thread = threading.Thread(
         target=self._dispatch_loop, name="micro-batcher", daemon=True)
     self._thread.start()
@@ -158,6 +175,9 @@ class MicroBatcher:
     if self._thread is not None:
       self._thread.join()
       self._thread = None
+    if self._heartbeat is not None:
+      self._watchdog.unregister(self._heartbeat)
+      self._heartbeat = None
 
   def __enter__(self) -> "MicroBatcher":
     return self.start()
@@ -206,7 +226,8 @@ class MicroBatcher:
         self._cond.notify_all()
 
   def submit(self, item: Any, slo: Optional[SLOClass] = None,
-             deadline_at: Optional[float] = None) -> Future:
+             deadline_at: Optional[float] = None,
+             request_id: Optional[str] = None) -> Future:
     """Enqueues one item; the Future resolves to its batch_fn result.
 
     Args:
@@ -217,50 +238,62 @@ class MicroBatcher:
         requests whose budget started at an upstream hop (the router's
         ingress clock); overrides the class budget. A deadline already
         in the past sheds the request immediately.
+      request_id: correlation id minted at an upstream ingress (router
+        / server); None inherits the caller's bound obs.context id or
+        mints one here. The id rides every span and flight-recorder
+        trigger this request touches.
     """
     slo = slo or self._default_slo
-    request = _Request(item, slo, deadline_at, self._margin_s)
-    # Expired at enqueue: the budget was consumed before the request
-    # ever reached this queue (negative class budget, or an upstream
-    # hop ate it). Shed immediately — counted, never dispatched, and
-    # never even enqueued, so an expired flood cannot wake the
-    # dispatcher into a shed-purge spin. The lifecycle check still
-    # applies first: a stopped batcher must raise, not dress the
-    # caller's bug up as ordinary load shedding.
-    if request.deadline < request.enqueued_at:
+    request = _Request(item, slo, deadline_at, self._margin_s,
+                       request_id=request_id)
+    # The enqueue span is the request timeline's first hop: it covers
+    # expiry check + EDF admission (+ a capacity eviction when one
+    # fires) and carries the correlation id, so the exported flow
+    # links it to the serve/flush that later ships the request.
+    with trace_lib.span("serve/enqueue", request_id=request.request_id,
+                        slo=slo.name):
+      # Expired at enqueue: the budget was consumed before the request
+      # ever reached this queue (negative class budget, or an upstream
+      # hop ate it). Shed immediately — counted, never dispatched, and
+      # never even enqueued, so an expired flood cannot wake the
+      # dispatcher into a shed-purge spin. The lifecycle check still
+      # applies first: a stopped batcher must raise, not dress the
+      # caller's bug up as ordinary load shedding.
+      if request.deadline < request.enqueued_at:
+        with self._cond:
+          if not self._running:
+            raise RuntimeError(
+                "MicroBatcher is not running; call start().")
+        if self._stats is not None:
+          self._stats.record_request(slo.name)
+        self._shed(request, "expired")
+        return request.future
       with self._cond:
         if not self._running:
           raise RuntimeError("MicroBatcher is not running; call start().")
+        victim = None
+        if self._max_queue is not None and self._live >= self._max_queue:
+          victim = self._pick_victim_locked(request)
+        if victim is not request:
+          head_flush_at = self._head_flush_at_locked()
+          heapq.heappush(self._heap,
+                         (request.flush_at, next(self._seq), request))
+          self._live += 1
+          # Wake the dispatcher only when its state actually changes:
+          # the first pending item (or a new EARLIEST deadline) re-arms
+          # the timed wait, and reaching max_batch triggers an
+          # immediate flush. Other arrivals ride the already-armed
+          # wait — on a busy fleet this cuts dispatcher wakeups from
+          # one per request to about two per flush, most of the
+          # batching win on a GIL-bound host.
+          if (head_flush_at is None or request.flush_at < head_flush_at
+              or self._live >= self._max_batch):
+            self._cond.notify()
       if self._stats is not None:
         self._stats.record_request(slo.name)
-      self._shed(request, "expired")
+      if victim is not None:
+        self._shed(victim, "capacity")
       return request.future
-    with self._cond:
-      if not self._running:
-        raise RuntimeError("MicroBatcher is not running; call start().")
-      victim = None
-      if self._max_queue is not None and self._live >= self._max_queue:
-        victim = self._pick_victim_locked(request)
-      if victim is not request:
-        head_flush_at = self._head_flush_at_locked()
-        heapq.heappush(self._heap,
-                       (request.flush_at, next(self._seq), request))
-        self._live += 1
-        # Wake the dispatcher only when its state actually changes: the
-        # first pending item (or a new EARLIEST deadline) re-arms the
-        # timed wait, and reaching max_batch triggers an immediate
-        # flush. Other arrivals ride the already-armed wait — on a busy
-        # fleet this cuts dispatcher wakeups from one per request to
-        # about two per flush, most of the batching win on a GIL-bound
-        # host.
-        if (head_flush_at is None or request.flush_at < head_flush_at
-            or self._live >= self._max_batch):
-          self._cond.notify()
-    if self._stats is not None:
-      self._stats.record_request(slo.name)
-    if victim is not None:
-      self._shed(victim, "capacity")
-    return request.future
 
   def _pick_victim_locked(self, incoming: _Request) -> Optional[_Request]:
     """Lowest-priority pending request (latest deadline breaks ties),
@@ -299,7 +332,8 @@ class MicroBatcher:
     # request into a submit()-side storage error.
     try:
       self._recorder.trigger("slo_breach", slo_class=request.slo.name,
-                             shed_reason=reason)
+                             shed_reason=reason,
+                             request_id=request.request_id)
     except Exception:
       pass
 
@@ -339,9 +373,18 @@ class MicroBatcher:
     therefore flushes immediately rather than re-arming a zero-length
     wait in a loop.
     """
+    heartbeat = self._heartbeat
     with self._cond:
       while True:
         self._dispatch_iterations += 1
+        # Liveness: pending work arms the stall clock (busy), an empty
+        # queue is intentional waiting (idle) — so a dispatcher wedged
+        # with live requests is a stall, a quiet fleet is not.
+        if heartbeat is not None:
+          if self._live > 0:
+            heartbeat.busy()
+          else:
+            heartbeat.idle()
         if not self._release.is_set() and self._running:
           # hold_flushes active: nothing is popped while held. The
           # timed wait covers the (benign) race of a release landing
@@ -365,6 +408,8 @@ class MicroBatcher:
             self._live -= n
             self._in_flight += n
             expired = now >= head and n < self._max_batch
+            if heartbeat is not None:
+              heartbeat.beat()
             return batch, expired
           self._cond.wait(timeout=head - now)
         elif not self._running:
@@ -381,16 +426,24 @@ class MicroBatcher:
     batch = [r for r in batch if r.future.set_running_or_notify_cancel()]
     if not batch:
       return
-    with trace_lib.span("serve/flush", batch=len(batch)):
-      try:
-        results = self._batch_fn([r.item for r in batch])
-      except Exception as e:  # fail the flush's requests, not the loop
-        self._recorder.record("event", "flush_failed",
-                              error=f"{type(e).__name__}: {e}",
-                              batch=len(batch))
-        for request in batch:
-          request.future.set_exception(e)
-        return
+    # The dispatcher is a different thread from the enqueuers, so the
+    # contextvar binding does NOT carry over — re-bind the batch's ids
+    # here. The serve/flush span (and any span batch_fn opens below
+    # it, e.g. the replica's device dispatch) carries them as one
+    # comma-joined `request_ids` attr; the trace exporter fans it back
+    # out into per-request flows.
+    batch_ids = context_lib.join_ids(r.request_id for r in batch)
+    with context_lib.bind(request_ids=batch_ids):
+      with trace_lib.span("serve/flush", batch=len(batch)):
+        try:
+          results = self._batch_fn([r.item for r in batch])
+        except Exception as e:  # fail the flush's requests, not the loop
+          self._recorder.record("event", "flush_failed",
+                                error=f"{type(e).__name__}: {e}",
+                                batch=len(batch))
+          for request in batch:
+            request.future.set_exception(e)
+          return
     done = time.perf_counter()
     for request, result in zip(batch, results):
       request.future.set_result(result)

@@ -46,7 +46,9 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from tensor2robot_tpu.obs import context as context_lib
 from tensor2robot_tpu.obs import flight_recorder as flight_lib
+from tensor2robot_tpu.obs import watchdog as watchdog_lib
 from tensor2robot_tpu.serving.batcher import MicroBatcher
 from tensor2robot_tpu.serving.router import FleetRouter
 from tensor2robot_tpu.serving.slo import SLOClass
@@ -175,11 +177,12 @@ class RolloutController:
                q_fn: Optional[Callable] = None,
                watcher: Optional[ExportWatcher] = None,
                poll_s: float = 0.2,
-               flight_recorder=None):
+               flight_recorder=None, watchdog=None):
     self._router = router
     self._predictor = predictor
     self._config = config or RolloutConfig()
     self._recorder = flight_recorder or flight_lib.get_recorder()
+    self._watchdog = watchdog or watchdog_lib.get_watchdog()
     self._q_fn = q_fn or self._default_q_fn
     self._watcher = watcher
     self._poll_s = poll_s
@@ -242,8 +245,14 @@ class RolloutController:
     # a request misrouted by one transition is just one more/fewer
     # sample — the accumulators are guarded where it matters.
     seed = self._router.assign_seed()
+    # ONE correlation id for the request AND any mirror/canary twin it
+    # spawns (ISSUE 12): the mirror is the same logical request served
+    # twice, so its spans must join the parent's timeline, not start
+    # their own.
+    request_id = context_lib.new_request_id()
     if state == "canary" and self._draw() < self._config.canary_fraction:
-      future = self._shadow_submit(image, seed, slo=slo)
+      future = self._shadow_submit(image, seed, slo=slo,
+                                   request_id=request_id)
       if future is not None:
         # Canary-served requests are REAL client traffic: account them
         # in the fleet's per-class stats (request + completion latency)
@@ -273,14 +282,17 @@ class RolloutController:
             "rollout_mirror", priority=-1,
             deadline_ms=slo.deadline_ms if slo is not None else 100.0)
         live_mirror = self._router.submit(image, slo=mirror_slo,
-                                          seed=seed)
+                                          seed=seed,
+                                          request_id=request_id)
         self._pair(image, live_mirror, future)
         return future
       # Shadow torn down between the state read and the submit (a
       # rollback raced us): fall through to the live path.
-    future = self._router.submit(image, slo=slo, seed=seed)
+    future = self._router.submit(image, slo=slo, seed=seed,
+                                 request_id=request_id)
     if state == "shadow" and self._draw() < self._config.mirror_fraction:
-      shadow_future = self._shadow_submit(image, seed)
+      shadow_future = self._shadow_submit(image, seed,
+                                          request_id=request_id)
       if shadow_future is not None:
         self._pair(image, future, shadow_future)
     return future
@@ -338,13 +350,14 @@ class RolloutController:
     self._lat_live_ms = []
     self._lat_shadow_ms = []
 
-  def _shadow_submit(self, image, seed,
-                     slo: Optional[SLOClass] = None) -> Optional[Future]:
+  def _shadow_submit(self, image, seed, slo: Optional[SLOClass] = None,
+                     request_id: Optional[str] = None) -> Optional[Future]:
     batcher = self._shadow_batcher
     if batcher is None:
       return None
     try:
-      return batcher.submit((np.asarray(image), int(seed)), slo=slo)
+      return batcher.submit((np.asarray(image), int(seed)), slo=slo,
+                            request_id=request_id)
     except RuntimeError:  # stopped between the check and the submit
       return None
 
@@ -385,23 +398,32 @@ class RolloutController:
     shadow_future.add_done_callback(lambda f: finish("shadow", f))
 
   def _run(self) -> None:
-    while True:
-      try:
-        item = self._work.get(timeout=self._poll_s)
-      except queue.Empty:
-        item = "tick"
-      if item is None:
-        return
-      try:
-        if item == "tick":
-          self._tick()
-        else:
-          _, payload = item
-          self._consume_pair(payload)
-      except Exception as e:
-        self._recorder.trigger("rollout_worker_exception",
-                               error=f"{type(e).__name__}: {e}")
-        _log.exception("rollout worker step failed; continuing")
+    # Liveness heartbeat (ISSUE 12): the worker wakes at least every
+    # poll_s by construction, so a healthy controller beats steadily
+    # and a wedged one (a q_fn stuck in device limbo) goes quiet and
+    # trips the watchdog.
+    heartbeat = self._watchdog.register("serve/rollout")
+    try:
+      while True:
+        try:
+          item = self._work.get(timeout=self._poll_s)
+        except queue.Empty:
+          item = "tick"
+        heartbeat.beat()
+        if item is None:
+          return
+        try:
+          if item == "tick":
+            self._tick()
+          else:
+            _, payload = item
+            self._consume_pair(payload)
+        except Exception as e:
+          self._recorder.trigger("rollout_worker_exception",
+                                 error=f"{type(e).__name__}: {e}")
+          _log.exception("rollout worker step failed; continuing")
+    finally:
+      self._watchdog.unregister(heartbeat)
 
   def _tick(self) -> None:
     if self._watcher is None or self._state != "serving":

@@ -34,8 +34,10 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from tensor2robot_tpu.obs import context as context_lib
 from tensor2robot_tpu.obs import flight_recorder as flight_lib
 from tensor2robot_tpu.obs import ledger as ledger_lib
+from tensor2robot_tpu.obs import trace as trace_lib
 from tensor2robot_tpu.serving.batcher import MicroBatcher
 from tensor2robot_tpu.serving.policy import CEMFleetPolicy
 from tensor2robot_tpu.serving.slo import SLOClass
@@ -60,7 +62,13 @@ class PolicyReplica:
   def _flush(self, items):
     images = [item[0] for item in items]
     seeds = np.asarray([item[1] for item in items], np.uint32)
-    return list(self.policy(images, seeds))
+    # The replica-dispatch hop of the request timeline: runs inside
+    # the batcher's serve/flush span (same thread), inheriting the
+    # batch's bound request_ids, and names the device the batch
+    # actually landed on.
+    with trace_lib.span("serve/dispatch", batch=len(items),
+                        device=str(self.device)):
+      return list(self.policy(images, seeds))
 
   def warmup(self, make_image) -> None:
     """Compiles the full ladder on this replica's device (server
@@ -182,29 +190,39 @@ class FleetRouter:
 
   def submit(self, image, slo: Optional[SLOClass] = None,
              seed: Optional[int] = None,
-             deadline_at: Optional[float] = None) -> Future:
+             deadline_at: Optional[float] = None,
+             request_id: Optional[str] = None) -> Future:
     """Enqueues one frame on the least-loaded replica.
 
     The request's absolute deadline is stamped HERE (router ingress),
     so replica queueing cannot silently extend a class budget: if the
     chosen replica's queue already ate the budget, the replica sheds it
     as expired (counted) instead of serving a dead answer.
+
+    The correlation id is stamped here too (ISSUE 12): minted per
+    request unless the caller passes one (the rollout controller's
+    mirror copy inherits its parent's id), bound for the routing
+    decision, and threaded onto the replica's pending record — every
+    span and flight-recorder trigger the request touches carries it.
     """
     if slo is not None and deadline_at is None:
       deadline_at = time.perf_counter() + slo.deadline_ms / 1e3
     seed = self.assign_seed() if seed is None else int(seed)
-    # Least-loaded with a ROTATING tie-break: bare min() resolves every
-    # tie to replica 0, hot-spotting one device whenever queues are
-    # equal (an idle fleet, or all-full under overload — where it also
-    # concentrates every eviction on one replica's queue).
-    offset = next(self._rr)
-    n = len(self.replicas)
-    replica = min(
-        ((r.batcher.pending(), (i - offset) % n, r)
-         for i, r in enumerate(self.replicas)),
-        key=lambda entry: entry[:2])[2]
-    return replica.batcher.submit(
-        (np.asarray(image), seed), slo=slo, deadline_at=deadline_at)
+    request_id = request_id or context_lib.new_request_id()
+    with context_lib.bind(request_id=request_id):
+      # Least-loaded with a ROTATING tie-break: bare min() resolves
+      # every tie to replica 0, hot-spotting one device whenever queues
+      # are equal (an idle fleet, or all-full under overload — where it
+      # also concentrates every eviction on one replica's queue).
+      offset = next(self._rr)
+      n = len(self.replicas)
+      replica = min(
+          ((r.batcher.pending(), (i - offset) % n, r)
+           for i, r in enumerate(self.replicas)),
+          key=lambda entry: entry[:2])[2]
+      return replica.batcher.submit(
+          (np.asarray(image), seed), slo=slo, deadline_at=deadline_at,
+          request_id=request_id)
 
   def act(self, image, slo: Optional[SLOClass] = None,
           timeout: Optional[float] = None) -> np.ndarray:

@@ -15,6 +15,7 @@ from typing import Optional
 
 import numpy as np
 
+from tensor2robot_tpu.obs import context as context_lib
 from tensor2robot_tpu.serving.batcher import MicroBatcher
 from tensor2robot_tpu.serving.policy import CEMFleetPolicy
 from tensor2robot_tpu.serving.stats import ServingStats
@@ -72,9 +73,12 @@ class FleetServer:
     """Enqueues one camera frame; resolves to its (action_size,) action.
     `slo` (serving/slo.py) overrides the default deadline class — the
     single-replica server honors the same EDF/shedding contract the
-    routed fleet does, which is what keeps it the semantics oracle."""
+    routed fleet does, which is what keeps it the semantics oracle.
+    This is an ingress: a correlation id is minted here (ISSUE 12)
+    and rides every span/dump the request touches."""
     seed = int(self._policy.assign_seeds(1)[0])
-    return self._batcher.submit((np.asarray(image), seed), slo=slo)
+    return self._batcher.submit((np.asarray(image), seed), slo=slo,
+                                request_id=context_lib.new_request_id())
 
   def act(self, image, timeout: Optional[float] = None,
           slo=None) -> np.ndarray:

@@ -1,15 +1,23 @@
-"""Observability spine (ISSUE 11 acceptance).
+"""Observability spine (ISSUE 11 acceptance) + fleet tier (ISSUE 12).
 
-Covers the four obs layers chiplessly: structured spans (nesting,
+Covers the obs layers chiplessly: structured spans (nesting,
 thread-safety, Chrome-trace export), the typed metric registry and its
 one MetricWriter bridge (host/pid stamped JSONL), the ExecutableLedger
 (compile counts + device-time attribution + the shared
 check_compile_ledger helper the replay/anakin/fleet smokes now use),
 the flight recorder (bounded ring, atomic schema'd dumps, rate limit,
-the INJECTED SLO breach under hold_flushes()), the guarded profiler
-window (no double start_trace when two capture paths are armed), the
-MetricWriter lifecycle satellite, and the obs_bench CLI protocol whose
-committed artifact is OBS_r12.json.
+the INJECTED SLO breach under hold_flushes(), per-instance recorders +
+the repoint warning), the guarded profiler window, the MetricWriter
+lifecycle satellite, and the obs_bench CLI protocol whose committed
+artifact is OBS_r13.json.
+
+Round 13 adds the cross-process tier: correlation ids (contextvar
+binding, span auto-attrs, Perfetto flows, THE tier-1 propagation test
+through FleetRouter + the rollout mirror), the stall/straggler
+watchdog (stall detection + escalation, the healthy-loop negative
+control), the fleet aggregator (reservoir-union percentiles, SLO
+rollup consistency, merged trace with cross-process flows), and the
+FLEETOBS CLI protocol whose committed artifact is FLEETOBS_r13.json.
 """
 
 import json
@@ -430,10 +438,11 @@ def obs_bench_results(tmp_path_factory):
 
 
 def _assert_obs_schema(results, committed: bool):
-  """The OBS_r12 contract shared by the CLI run and the committed
+  """The OBS_r13 contract shared by the CLI run and the committed
   artifact: attribution completeness, shares <= 1.0, ledger_ok,
-  flight-recorder schema, per-stage trace coverage."""
-  assert results["round"] == 12
+  flight-recorder schema, per-stage trace coverage, and (r13) the
+  watchdog controls + the aggregator self-check blocks."""
+  assert results["round"] == 13
   assert results["virtual_mesh"] is (
       results["device_kind"].lower() == "cpu")
   for phase in ("replay", "host_loop"):
@@ -480,6 +489,24 @@ def _assert_obs_schema(results, committed: bool):
   for stage in ("act", "extend", "learn", "serve"):
     assert stages.get(stage, 0) >= 1, stages
   assert results["flightrec_schema"] == "t2r-flightrec-1"
+  # Round 13: watchdog controls (injected stall fired + schema-valid
+  # dump; healthy control silent) and the aggregator self-check over
+  # the run's own artifacts (consistent rollup, >= 1 correlation-linked
+  # serve timeline).
+  watchdog = results["watchdog"]
+  assert watchdog["injected_stall"]["ok"] is True
+  assert watchdog["injected_stall"]["events"] >= 1
+  assert watchdog["injected_stall"]["dump_schema"] == "t2r-flightrec-1"
+  assert watchdog["healthy_control"]["ok"] is True
+  assert watchdog["healthy_control"]["events"] == 0
+  fleetobs = results["fleetobs"]
+  assert fleetobs["consistent"] is True
+  assert fleetobs["hosts_merged"] >= 1
+  assert fleetobs["slo"]["shed_total"] >= breach["shed"]
+  assert fleetobs["trace"]["linked_serve_timelines"] >= 1
+  assert fleetobs["trace"]["example_timeline"]["spans"][:1] == [
+      "serve/enqueue"]
+  assert fleetobs["flightrec_reasons"].get("watchdog_stall", 0) >= 1
   if committed:
     assert results["devices"] == 8 and results["mesh_dp"] == 8
 
@@ -539,16 +566,818 @@ class TestObsBenchCLI:
 
 class TestCommittedObsArtifact:
 
-  def test_obs_r12_json_matches_schema(self):
-    """OBS_r12.json (the committed acceptance artifact) parses and
+  def test_obs_r13_json_matches_schema(self):
+    """OBS_r13.json (the committed acceptance artifact) parses and
     holds the full-protocol contract: 8-virtual-device mesh, shares
     <= 1.0, every dispatched executable present, breach dump recorded,
-    all four loop stages in the trace counts."""
-    path = os.path.join(ROOT, "OBS_r12.json")
-    assert os.path.exists(path), "committed OBS_r12.json missing"
+    all four loop stages in the trace counts, the watchdog controls,
+    and the aggregator self-check."""
+    path = os.path.join(ROOT, "OBS_r13.json")
+    assert os.path.exists(path), "committed OBS_r13.json missing"
     with open(path) as f:
       results = json.loads(f.read().strip())
     _assert_obs_schema(results, committed=True)
     # The committed run used the full smoke budget and learned.
     assert results["replay"]["steps"] >= 300
     assert results["replay"]["eval_td_reduction"] >= 0.30
+
+
+class TestCorrelationContext:
+  """ISSUE 12 tentpole (a), unit layer: contextvar binding, span
+  auto-attrs, and the Perfetto flow linker."""
+
+  def test_mint_is_host_pid_unique_and_monotonic(self):
+    from tensor2robot_tpu.obs import context as context_lib
+    a, b = context_lib.new_request_id(), context_lib.new_request_id()
+    assert a != b
+    assert str(os.getpid()) in a
+
+  def test_bind_nests_and_restores(self):
+    from tensor2robot_tpu.obs import context as context_lib
+    assert context_lib.current_request_id() is None
+    with context_lib.bind(request_id="r1"):
+      assert context_lib.current_request_id() == "r1"
+      with context_lib.bind(step_id=7):
+        # Nested step_id bind keeps the enclosing request_id.
+        attrs = context_lib.context_attrs()
+        assert attrs == {"request_id": "r1", "step_id": 7}
+      assert context_lib.context_attrs() == {"request_id": "r1"}
+    assert context_lib.current_request_id() is None
+
+  def test_spans_inherit_bound_ids_and_explicit_attrs_win(self):
+    from tensor2robot_tpu.obs import context as context_lib
+    from tensor2robot_tpu.obs.trace import Tracer
+    tracer = Tracer()
+    with context_lib.bind(request_id="r-auto", step_id=3):
+      with tracer.span("serve/flush"):
+        pass
+      with tracer.span("serve/enqueue", request_id="r-explicit"):
+        pass
+    auto, explicit = tracer.spans()
+    assert auto["request_id"] == "r-auto" and auto["step_id"] == 3
+    assert explicit["request_id"] == "r-explicit"
+
+  def test_span_request_ids_decoder(self):
+    from tensor2robot_tpu.obs import context as context_lib
+    assert list(context_lib.span_request_ids(
+        {"request_id": "a"})) == ["a"]
+    assert list(context_lib.span_request_ids(
+        {"request_ids": "a,b,c"})) == ["a", "b", "c"]
+    # The batch form dedupes against the single form.
+    assert list(context_lib.span_request_ids(
+        {"request_id": "a", "request_ids": "a,b"})) == ["a", "b"]
+    assert context_lib.join_ids(["a", None, "b"]) == "a,b"
+
+  def test_export_links_request_spans_into_flows(self, tmp_path):
+    from tensor2robot_tpu.obs import context as context_lib
+    from tensor2robot_tpu.obs.trace import Tracer
+    tracer = Tracer()
+    with context_lib.bind(request_id="req-x"):
+      with tracer.span("serve/enqueue"):
+        pass
+    with context_lib.bind(request_ids="req-x,req-lonely"):
+      with tracer.span("serve/flush", batch=2):
+        pass
+    path = tracer.export_chrome_trace(str(tmp_path / "t.json"))
+    with open(path) as f:
+      events = json.load(f)["traceEvents"]
+    flows = [e for e in events if e.get("cat") == "request"]
+    # req-x has two spans -> one s + one f arrow; req-lonely has one
+    # span -> no arrow (a flow needs two ends).
+    assert [e["ph"] for e in flows] == ["s", "f"]
+    assert all(e["name"] == "request req-x" for e in flows)
+    assert flows[0]["id"] == flows[1]["id"]
+
+
+class TestCorrelationPropagation:
+  """THE tier-1 satellite: requests through FleetRouter with distinct
+  SLO classes — every span and the injected-breach dump carry the
+  correct request_id, and the rollout mirror inherits its parent's."""
+
+  def _router(self, predictor, recorder, n_devices=2):
+    import jax
+
+    from tensor2robot_tpu.obs.registry import MetricRegistry
+    from tensor2robot_tpu.serving.router import FleetRouter
+    from tensor2robot_tpu.serving.stats import ServingStats
+    return FleetRouter(
+        predictor, devices=jax.devices()[:n_devices], num_samples=16,
+        num_elites=4, iterations=2, seed=0, ladder_sizes=(1, 2),
+        max_queue=2, stats=ServingStats(registry=MetricRegistry()),
+        flight_recorder=recorder)
+
+  def test_spans_and_breach_dump_carry_request_ids(self, tmp_path):
+    import contextlib
+
+    from tensor2robot_tpu.obs import trace as trace_lib
+    from tensor2robot_tpu.serving.slo import SLOClass
+    from tensor2robot_tpu.serving.smoke import TinyQPredictor
+
+    predictor = TinyQPredictor(image_size=8, action_size=4, seed=0)
+    recorder = FlightRecorder(dump_dir=str(tmp_path),
+                              min_dump_interval_s=0.0)
+    router = self._router(predictor, recorder)
+    router.warmup(predictor.make_image)
+    interactive = SLOClass("interactive", priority=2, deadline_ms=200.0)
+    batch_class = SLOClass("batch", priority=0, deadline_ms=2000.0)
+    with router:
+      live = {}
+      for i in range(4):
+        rid = f"corr-live-{i}"
+        live[rid] = router.submit(predictor.make_image(i),
+                                  slo=interactive, request_id=rid)
+      for future in live.values():
+        future.result(timeout=30)
+      # Injected breach under held flushes: deterministic capacity
+      # sheds whose dumps must name the shed request.
+      burst_ids = []
+      with contextlib.ExitStack() as stack:
+        for replica in router.replicas:
+          stack.enter_context(replica.batcher.hold_flushes())
+        for j in range(8):
+          rid = f"corr-burst-{j}"
+          burst_ids.append(rid)
+          router.submit(predictor.make_image(j), slo=batch_class,
+                        request_id=rid)
+    spans = trace_lib.get_tracer().spans()
+    enqueue = {s["request_id"]: s for s in spans
+               if s["name"] == "serve/enqueue"
+               and str(s.get("request_id", "")).startswith("corr-")}
+    # Every submit produced an enqueue span with ITS id and class.
+    for rid in live:
+      assert enqueue[rid]["slo"] == "interactive"
+    for rid in burst_ids:
+      assert enqueue[rid]["slo"] == "batch"
+    # Every completed live request appears in a flush span's batch ids
+    # (same id across threads — the flow the exporter links).
+    flush_ids = set()
+    for span in spans:
+      if span["name"] in ("serve/flush", "serve/dispatch"):
+        flush_ids.update(str(span.get("request_ids", "")).split(","))
+    assert set(live) <= flush_ids, (sorted(live), sorted(flush_ids)[:10])
+    # The breach dump names the shed request, top-level and in the
+    # trigger context.
+    assert recorder.dumps_written >= 1
+    with open(recorder.last_dump_path) as f:
+      payload = json.load(f)
+    assert payload["reason"] == "slo_breach"
+    assert payload["request_id"].startswith("corr-burst-")
+    assert payload["trigger"]["slo_class"] == "batch"
+    assert payload["trigger"]["request_id"] == payload["request_id"]
+
+  def test_rollout_mirror_inherits_parent_request_id(self, tmp_path):
+    import time as time_lib
+
+    from tensor2robot_tpu.obs import trace as trace_lib
+    from tensor2robot_tpu.serving.rollout import (RolloutConfig,
+                                                  RolloutController)
+    from tensor2robot_tpu.serving.slo import SLOClass
+    from tensor2robot_tpu.serving.smoke import TinyQPredictor
+
+    predictor = TinyQPredictor(image_size=8, action_size=4, seed=0)
+    recorder = FlightRecorder()
+    router = self._router(predictor, recorder)
+    router.warmup(predictor.make_image)
+    interactive = SLOClass("interactive", priority=2, deadline_ms=200.0)
+    with router:
+      controller = RolloutController(
+          router, predictor,
+          RolloutConfig(mirror_fraction=1.0, canary_fraction=1.0,
+                        min_shadow_samples=1, min_canary_samples=10_000),
+          flight_recorder=recorder)
+      with controller:
+        controller.offer_candidate(
+            1, predictor.make_candidate_variables(jitter=0.0))
+        deadline = time_lib.time() + 30.0
+        while controller.state != "canary" and time_lib.time() < deadline:
+          controller.act(predictor.make_image(100), timeout=10)
+        assert controller.state == "canary", controller.state
+        futures = [controller.submit(predictor.make_image(200 + i),
+                                     slo=interactive)
+                   for i in range(4)]
+        for future in futures:
+          future.result(timeout=30)
+    spans = trace_lib.get_tracer().spans()
+    mirror_ids = {s["request_id"] for s in spans
+                  if s["name"] == "serve/enqueue"
+                  and s.get("slo") == "rollout_mirror"}
+    assert mirror_ids, "canary phase produced no mirror requests"
+    # Each mirror id must ALSO appear on a non-mirror enqueue span —
+    # the parent client request whose timeline the mirror joins.
+    parent_ids = {s["request_id"] for s in spans
+                  if s["name"] == "serve/enqueue"
+                  and s.get("slo") not in (None, "rollout_mirror")}
+    assert mirror_ids <= parent_ids, (mirror_ids, sorted(parent_ids)[-8:])
+
+
+class TestWatchdog:
+  """ISSUE 12 tentpole (c), unit layer."""
+
+  def _watchdog(self, tmp_path, **kwargs):
+    from tensor2robot_tpu.obs.registry import MetricRegistry
+    from tensor2robot_tpu.obs.watchdog import Watchdog
+    registry = MetricRegistry()
+    recorder = FlightRecorder(dump_dir=str(tmp_path),
+                              min_dump_interval_s=0.0)
+    return Watchdog(poll_s=0.05, default_deadline_s=0.2,
+                    recorder=recorder, registry=registry,
+                    **kwargs), recorder, registry
+
+  def test_stall_escalates_counter_dump_callback(self, tmp_path):
+    import time as time_lib
+    stalls = []
+    watchdog, recorder, registry = self._watchdog(
+        tmp_path, on_stall=stalls.append)
+    heartbeat = watchdog.register("replay/learner")
+    heartbeat.busy()
+    time_lib.sleep(0.3)
+    events = watchdog.check_once()
+    assert len(events) == 1
+    assert events[0]["component"] == "replay/learner"
+    assert registry.counter("watchdog/stalls").value == 1
+    assert registry.counter(
+        "watchdog/stall/replay/learner").value == 1
+    assert stalls == events
+    assert recorder.dumps_written == 1
+    with open(recorder.last_dump_path) as f:
+      payload = json.load(f)
+    assert payload["schema"] == SCHEMA
+    assert payload["reason"] == "watchdog_stall"
+    from tensor2robot_tpu.obs.watchdog import STALL_FIELDS
+    for field in STALL_FIELDS:
+      assert field in payload["trigger"], payload["trigger"]
+    # One stall episode = one event: a second check does not re-fire.
+    assert watchdog.check_once() == []
+
+  def test_idle_components_never_stall_and_busy_arms(self, tmp_path):
+    import time as time_lib
+    watchdog, _, _ = self._watchdog(tmp_path)
+    heartbeat = watchdog.register("serve/batcher")  # born idle
+    time_lib.sleep(0.3)
+    assert watchdog.check_once() == []
+    heartbeat.busy()  # work arrives: deadline runs from NOW
+    assert watchdog.check_once() == []
+    time_lib.sleep(0.3)
+    assert len(watchdog.check_once()) == 1
+    heartbeat.idle()  # queue drained: stall clears, no new event
+    assert watchdog.check_once() == []
+    assert watchdog.events[-1]["event"] == "watchdog_recovered"
+
+  def test_recovery_rearms_detection(self, tmp_path):
+    import time as time_lib
+    watchdog, _, registry = self._watchdog(tmp_path)
+    heartbeat = watchdog.register("act/collector")
+    heartbeat.beat()
+    time_lib.sleep(0.3)
+    assert len(watchdog.check_once()) == 1
+    heartbeat.beat()  # recovers
+    assert watchdog.check_once() == []
+    time_lib.sleep(0.3)  # stalls AGAIN -> a second episode
+    assert len(watchdog.check_once()) == 1
+    assert registry.counter("watchdog/stalls").value == 2
+
+  def test_unregister_and_name_uniquification(self, tmp_path):
+    watchdog, _, _ = self._watchdog(tmp_path)
+    first = watchdog.register("replay/learner")
+    second = watchdog.register("replay/learner")
+    assert second.name == "replay/learner#2"
+    watchdog.unregister(first)
+    watchdog.unregister(first)  # idempotent
+    assert "replay/learner" not in watchdog.snapshot()["components"]
+    assert "replay/learner#2" in watchdog.snapshot()["components"]
+
+  def test_reregistered_name_does_not_inherit_stall(self, tmp_path):
+    """A component that stalled, unregistered, and re-registered under
+    the same name (a restarted batcher) starts clean: no inherited
+    stall state, no phantom recovery event."""
+    import time as time_lib
+    watchdog, _, _ = self._watchdog(tmp_path)
+    first = watchdog.register("serve/batcher")
+    first.busy()
+    time_lib.sleep(0.3)
+    assert len(watchdog.check_once()) == 1
+    watchdog.unregister(first)
+    events_before = len(watchdog.events)
+    fresh = watchdog.register("serve/batcher")  # born idle
+    assert watchdog.check_once() == []
+    assert len(watchdog.events) == events_before
+    assert watchdog.snapshot()["components"]["serve/batcher"][
+        "stalled"] is False
+    del fresh
+
+  def test_callback_exception_is_isolated(self, tmp_path):
+    import time as time_lib
+
+    def explode(event):
+      raise RuntimeError("listener bug")
+
+    watchdog, _, registry = self._watchdog(tmp_path, on_stall=explode)
+    heartbeat = watchdog.register("replay/learner")
+    heartbeat.busy()
+    time_lib.sleep(0.3)
+    events = watchdog.check_once()  # must not raise
+    assert len(events) == 1
+    assert registry.counter("watchdog/stalls").value == 1
+
+  def test_find_stragglers(self):
+    from tensor2robot_tpu.obs.watchdog import find_stragglers
+    result = find_stragglers(
+        {"a:1": 100.0, "b:2": 96.0, "c:3": 10.0}, fraction=0.5)
+    assert result["fleet_median"] == 96.0
+    assert [s["name"] for s in result["stragglers"]] == ["c:3"]
+    # A stopped host (rate None/0) is the worst straggler, not an
+    # excluded one.
+    result = find_stragglers({"a:1": 100.0, "b:2": None})
+    assert [s["name"] for s in result["stragglers"]] == ["b:2"]
+    # A fleet of one has no median to straggle against.
+    assert find_stragglers({"a:1": 5.0})["stragglers"] == []
+
+  def test_scaled_deadline_follows_core_gate(self, monkeypatch):
+    from tensor2robot_tpu.obs import watchdog as watchdog_lib
+    monkeypatch.setattr(os, "cpu_count", lambda: 2)
+    assert watchdog_lib.scaled_deadline(1.0) == 4.0
+    monkeypatch.setattr(os, "cpu_count", lambda: 8)
+    assert watchdog_lib.scaled_deadline(1.0) == 1.0
+
+
+class TestWatchdogNegativeControl:
+  """ISSUE 12 satellite: a HEALTHY loop run produces zero watchdog
+  events — the guard against false-positive stall dumps from slow-CI
+  scheduling noise (deadlines scale per the cpu_count >= 4 gating
+  convention)."""
+
+  def test_healthy_replay_loop_run_is_silent(self, tmp_path):
+    import optax
+
+    from tensor2robot_tpu.bin.run_qtopt_replay import build_config
+    from tensor2robot_tpu.obs.registry import MetricRegistry
+    from tensor2robot_tpu.obs.watchdog import Watchdog, scaled_deadline
+    from tensor2robot_tpu.replay.loop import ReplayTrainLoop
+    from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+    from dataclasses import replace
+
+    config = build_config(smoke=True, seed=3)
+    config = replace(config, capacity=256, min_fill=64, eval_every=16,
+                     log_every=8)
+    model = TinyQCriticModel(
+        image_size=config.image_size, action_size=config.action_size,
+        optimizer_fn=lambda: optax.adam(config.learning_rate))
+    watchdog = Watchdog(
+        poll_s=0.1, recorder=FlightRecorder(dump_dir=str(tmp_path)),
+        registry=MetricRegistry(),
+        default_deadline_s=scaled_deadline(30.0))
+    loop = ReplayTrainLoop(config, str(tmp_path / "logs"), model=model,
+                           watchdog=watchdog)
+    with watchdog:  # the monitor REALLY runs across the whole loop
+      results = loop.run(16)
+    assert results["steps"] >= 16
+    assert watchdog.events == [], watchdog.events
+    assert watchdog.stall_count == 0
+    assert not [name for name in os.listdir(tmp_path)
+                if name.startswith("flightrec-")]
+    # The loop's heartbeats were wired, not absent: components were
+    # registered and unregistered on the way out.
+    assert watchdog.snapshot()["components"] == {}
+
+
+class TestAggregate:
+  """ISSUE 12 tentpole (b), unit layer: synthetic multi-process logdir
+  merged with known-answer checks."""
+
+  def _write_process(self, logdir, host, pid, steps, latencies,
+                     requests, shed_capacity, t0=1000.0):
+    """One fake process's streams: metrics.jsonl + registry snapshot."""
+    directory = os.path.join(logdir, f"{host}-{pid}")
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, "metrics.jsonl"), "w") as f:
+      for index, step in enumerate(steps):
+        f.write(json.dumps({
+            "step": step, "wall_time": t0 + index,
+            "host": host, "pid": pid,
+            "serving/shed_total": shed_capacity,
+        }) + "\n")
+    snapshot = {
+        "schema": "t2r-registry-1", "host": host, "pid": pid,
+        "counters": {
+            "serving/requests": requests,
+            "serving/shed_capacity": shed_capacity,
+            "serving/class/batch/requests": requests,
+            "serving/class/batch/shed_capacity": shed_capacity,
+        },
+        "gauges": {"replay/fill": 0.5},
+        "histograms": {
+            "serving/class/batch/latency_ms": {
+                "count": len(latencies), "samples": latencies},
+        },
+    }
+    with open(os.path.join(directory, "registry.json"), "w") as f:
+      json.dump(snapshot, f)
+    return directory
+
+  def test_reservoir_union_is_the_one_percentile_source(self, tmp_path):
+    from tensor2robot_tpu.obs.aggregate import aggregate_logdir
+    # Process A holds samples 1..50, process B 51..100: the merged
+    # p50/p99 must come from the UNION (50/99-ish), which neither
+    # process's own percentiles (25/50 and 75/100) could produce by
+    # averaging.
+    self._write_process(str(tmp_path), "hostA", 11, [1, 2, 3],
+                        [float(v) for v in range(1, 51)], 50, 0)
+    self._write_process(str(tmp_path), "hostB", 22, [1, 2, 3],
+                        [float(v) for v in range(51, 101)], 50, 0)
+    fleet = aggregate_logdir(str(tmp_path))
+    merged = fleet["registry"]["histograms"][
+        "serving/class/batch/latency_ms"]
+    assert merged["merged_samples"] == 100
+    assert merged["p50"] == 50.0
+    assert merged["p99"] == 99.0
+    assert fleet["hosts_merged"] == 2
+    assert sorted(fleet["hosts"]) == ["hostA", "hostB"]
+
+  def test_slo_rollup_sums_classes_and_checks_consistency(self, tmp_path):
+    from tensor2robot_tpu.obs.aggregate import aggregate_logdir
+    self._write_process(str(tmp_path), "hostA", 11, [1, 2], [5.0], 40, 8)
+    self._write_process(str(tmp_path), "hostB", 22, [1, 2], [9.0], 60, 16)
+    fleet = aggregate_logdir(str(tmp_path))
+    slo = fleet["slo"]
+    assert slo["per_class"]["batch"]["requests"] == 100
+    assert slo["per_class"]["batch"]["shed_capacity"] == 24
+    assert slo["shed_total"] == 24
+    assert slo["consistent"] is True
+
+  def test_inconsistent_source_is_flagged(self, tmp_path):
+    from tensor2robot_tpu.obs.aggregate import aggregate_logdir
+    directory = self._write_process(str(tmp_path), "hostA", 11,
+                                    [1], [5.0], 40, 8)
+    # Corrupt the snapshot: global shed counter without the class
+    # counter — sheds that bypassed class accounting.
+    path = os.path.join(directory, "registry.json")
+    with open(path) as f:
+      snapshot = json.load(f)
+    del snapshot["counters"]["serving/class/batch/shed_capacity"]
+    with open(path, "w") as f:
+      json.dump(snapshot, f)
+    fleet = aggregate_logdir(str(tmp_path))
+    assert fleet["slo"]["consistent"] is False
+
+  def test_per_host_step_rates_feed_straggler_detection(self, tmp_path):
+    from tensor2robot_tpu.obs.aggregate import aggregate_logdir
+    # 1 step/s vs 10 steps/s over the same wall span.
+    self._write_process(str(tmp_path), "hostA", 11,
+                        list(range(0, 101, 10)), [1.0], 10, 0)
+    self._write_process(str(tmp_path), "hostB", 22,
+                        list(range(0, 11, 1)), [1.0], 10, 0)
+    self._write_process(str(tmp_path), "hostC", 33,
+                        list(range(0, 101, 10)), [1.0], 10, 0)
+    fleet = aggregate_logdir(str(tmp_path))
+    assert fleet["per_host"]["hostA:11"]["step_rate"] == 10.0
+    assert fleet["per_host"]["hostB:22"]["step_rate"] == 1.0
+    assert [s["name"] for s in fleet["stragglers"]["stragglers"]] == [
+        "hostB:22"]
+    for entry in fleet["per_host"].values():
+      assert entry["step_series"], entry  # the per-host series
+
+  def test_wedged_stream_is_worst_straggler_not_excluded(self, tmp_path):
+    """A host stuck at step N that keeps emitting health records must
+    read step_rate 0.0 and be flagged — None would silently drop it
+    from the fleet-median comparison (the exact host the detector
+    exists for)."""
+    from tensor2robot_tpu.obs.aggregate import aggregate_logdir
+    self._write_process(str(tmp_path), "hostA", 11,
+                        list(range(0, 11)), [1.0], 10, 0)
+    self._write_process(str(tmp_path), "hostB", 22,
+                        list(range(0, 11)), [1.0], 10, 0)
+    self._write_process(str(tmp_path), "hostC", 33,
+                        [7] * 11, [1.0], 10, 0)  # wedged at step 7
+    fleet = aggregate_logdir(str(tmp_path))
+    assert fleet["per_host"]["hostC:33"]["step_rate"] == 0.0
+    assert [s["name"] for s in fleet["stragglers"]["stragglers"]] == [
+        "hostC:33"]
+
+  def test_trace_merge_links_request_across_processes(self, tmp_path):
+    from tensor2robot_tpu.obs.aggregate import aggregate_logdir
+
+    def chrome(host, pid, names_and_ids, path):
+      events = [{"name": "process_name", "ph": "M", "pid": pid,
+                 "tid": 0, "args": {"name": f"{host}:{pid}"}}]
+      for index, (name, rid) in enumerate(names_and_ids):
+        events.append({
+            "name": name, "ph": "X", "ts": 1000.0 * index, "dur": 500.0,
+            "pid": pid, "tid": 1, "args": {"request_id": rid}})
+      with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+
+    os.makedirs(tmp_path / "p1"), os.makedirs(tmp_path / "p2")
+    chrome("hostA", 11,
+           [("serve/enqueue", "req-7"), ("serve/flush", "req-7"),
+            ("serve/dispatch", "req-7")],
+           str(tmp_path / "p1" / "trace.json"))
+    chrome("hostB", 22, [("serve/flush", "req-7")],
+           str(tmp_path / "p2" / "trace.json"))
+    fleet = aggregate_logdir(str(tmp_path))
+    trace = fleet["trace"]
+    assert trace["request_ids_seen"] == 1
+    assert trace["flows_linked"] == 1
+    assert trace["linked_serve_timelines"] == 1
+    assert trace["cross_process_flows"] == 1
+    # Time-ordered across BOTH processes (hostB's flush ties hostA's
+    # enqueue at ts 0 and sorts stably after it).
+    assert trace["example_timeline"]["spans"] == [
+        "serve/enqueue", "serve/flush", "serve/flush", "serve/dispatch"]
+    merged_path = os.path.join(tmp_path, "fleet_trace.json")
+    with open(merged_path) as f:
+      merged = json.load(f)["traceEvents"]
+    # Host-prefixed lanes with remapped pids; flows cross the lanes.
+    lanes = {e["args"]["name"]: e["pid"] for e in merged
+             if e.get("ph") == "M"}
+    assert set(lanes) == {"hostA:11", "hostB:22"}
+    assert len(set(lanes.values())) == 2
+    flow_pids = {e["pid"] for e in merged if e.get("cat") == "request"}
+    assert len(flow_pids) == 2
+    # A re-run must not ingest its own merged output.
+    again = aggregate_logdir(str(tmp_path))
+    assert again["trace"]["request_ids_seen"] == 1
+
+  def test_trace_merge_aligns_lanes_by_wall_epoch(self, tmp_path):
+    """Per-process ts is relative to each Tracer's OWN perf_counter
+    epoch; the exporter's epoch_wall_s anchor lets the merge offset
+    lanes onto one comparable timeline — without it every lane would
+    stack at ts 0 and cross-process flows could point backward."""
+    from tensor2robot_tpu.obs.aggregate import aggregate_logdir
+
+    def chrome(host, pid, epoch_wall, spans, path):
+      events = [{"name": "process_name", "ph": "M", "pid": pid,
+                 "tid": 0, "args": {"name": f"{host}:{pid}",
+                                    "epoch_wall_s": epoch_wall}}]
+      for name, ts in spans:
+        events.append({"name": name, "ph": "X", "ts": ts, "dur": 50.0,
+                       "pid": pid, "tid": 1,
+                       "args": {"request_id": "req-1"}})
+      with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+
+    os.makedirs(tmp_path / "p1"), os.makedirs(tmp_path / "p2")
+    # Process B's tracer epoch is 0.0001 wall seconds (100 us) after
+    # A's; its flush at LOCAL ts 100 really happened between A's
+    # enqueue (0) and dispatch (400) on the shared clock.
+    chrome("hostA", 11, 100.0,
+           [("serve/enqueue", 0.0), ("serve/dispatch", 400.0)],
+           str(tmp_path / "p1" / "trace.json"))
+    chrome("hostB", 22, 100.0001, [("serve/flush", 100.0)],
+           str(tmp_path / "p2" / "trace.json"))
+    fleet = aggregate_logdir(str(tmp_path))
+    offsets = {s["process"]: s["offset_us"]
+               for s in fleet["trace"]["sources"]}
+    assert offsets == {"hostA:11": 0.0, "hostB:22": 100.0}
+    with open(os.path.join(tmp_path, "fleet_trace.json")) as f:
+      merged = json.load(f)["traceEvents"]
+    ts_by_name = {e["name"]: e["ts"] for e in merged
+                  if e.get("ph") == "X"}
+    assert ts_by_name["serve/flush"] == 200.0  # 100 local + 100 offset
+    # The cross-process flow chain is therefore in TRUE wall order —
+    # raw concatenation would have sorted B's flush first.
+    assert fleet["trace"]["example_timeline"]["spans"] == [
+        "serve/enqueue", "serve/flush", "serve/dispatch"]
+    assert fleet["trace"]["cross_process_flows"] == 1
+
+  def test_watchdog_stall_dumps_validated(self, tmp_path):
+    from tensor2robot_tpu.obs.aggregate import aggregate_logdir
+    from tensor2robot_tpu.obs.watchdog import Watchdog
+    watchdog = Watchdog(
+        poll_s=0.05, default_deadline_s=0.1,
+        recorder=FlightRecorder(dump_dir=str(tmp_path / "wd"),
+                                min_dump_interval_s=0.0))
+    heartbeat = watchdog.register("replay/learner")
+    heartbeat.busy()
+    import time as time_lib
+    time_lib.sleep(0.2)
+    assert watchdog.check_once()
+    fleet = aggregate_logdir(str(tmp_path))
+    assert fleet["flightrec"]["reasons"] == {"watchdog_stall": 1}
+    stall = fleet["flightrec"]["watchdog_stalls"][0]
+    assert stall["schema_ok"] is True
+    assert stall["component"] == "replay/learner"
+
+
+class TestFlightRecorderRound13:
+  """ISSUE 12 satellite: per-recorder instances + the repoint warning
+  + trigger context in dumps."""
+
+  def test_repoint_warns_same_dir_does_not(self, tmp_path, caplog):
+    import logging
+    recorder = FlightRecorder()
+    with caplog.at_level(logging.WARNING,
+                         logger="tensor2robot_tpu.obs.flight_recorder"):
+      recorder.configure(dump_dir=str(tmp_path / "a"))
+      recorder.configure(dump_dir=str(tmp_path / "a"))  # same: quiet
+      assert not caplog.records
+      recorder.configure(dump_dir=str(tmp_path / "b"))  # repoint: loud
+    assert any("repointed" in record.getMessage()
+               for record in caplog.records)
+
+  def test_per_loop_instances_keep_dumps_apart(self, tmp_path):
+    from tensor2robot_tpu.obs.trace import Tracer
+    tracer = Tracer()
+    first = FlightRecorder(dump_dir=str(tmp_path / "loop1"),
+                           min_dump_interval_s=0.0)
+    second = FlightRecorder(dump_dir=str(tmp_path / "loop2"),
+                            min_dump_interval_s=0.0)
+    first.attach(tracer)
+    second.attach(tracer)
+    with tracer.span("learn/step"):
+      pass
+    assert first.events()[-1]["name"] == "learn/step"
+    assert second.events()[-1]["name"] == "learn/step"
+    first.trigger("loop1_failure")
+    second.trigger("loop2_failure")
+    assert os.listdir(tmp_path / "loop1") != os.listdir(
+        tmp_path / "loop2")
+    # Detach stops the feed (the per-run listener hygiene the loop
+    # relies on); detaching twice is a no-op.
+    first.detach(tracer)
+    first.detach(tracer)
+    before = first.events_total
+    with tracer.span("learn/step2"):
+      pass
+    assert first.events_total == before
+    assert second.events()[-1]["name"] == "learn/step2"
+
+  def test_replay_loop_owns_its_recorder(self, tmp_path):
+    """Two loops in one process dump into their OWN logdirs — the
+    last-configured-wins footgun PR 8 handed off is closed."""
+    from tensor2robot_tpu.bin.run_qtopt_replay import build_config
+    from tensor2robot_tpu.replay.loop import ReplayTrainLoop
+    from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+    import optax
+
+    config = build_config(smoke=True, seed=0)
+    model = TinyQCriticModel(
+        image_size=config.image_size, action_size=config.action_size,
+        optimizer_fn=lambda: optax.adam(config.learning_rate))
+    loop_a = ReplayTrainLoop(config, str(tmp_path / "a"), model=model)
+    loop_b = ReplayTrainLoop(config, str(tmp_path / "b"), model=model)
+    assert loop_a.recorder is not loop_b.recorder
+    assert loop_a.recorder.dump_dir != loop_b.recorder.dump_dir
+    loop_a.recorder.trigger("loop_a_event")
+    assert [name for name in os.listdir(tmp_path / "a")
+            if name.startswith("flightrec-")]
+    assert not (tmp_path / "b").exists() or not [
+        name for name in os.listdir(tmp_path / "b")
+        if name.startswith("flightrec-")]
+
+  def test_actor_death_dumps_into_injected_recorder(self, tmp_path):
+    """VectorActor takes the owner's recorder/watchdog (the
+    CollectorWorker contract): a dying actor thread dumps into the
+    LOOP's logdir, not the unconfigured process recorder's ring."""
+    import time as time_lib
+
+    from tensor2robot_tpu.obs.watchdog import Watchdog
+    from tensor2robot_tpu.replay.actor import VectorActor
+    from tensor2robot_tpu.replay.ingest import TransitionQueue
+
+    recorder = FlightRecorder(dump_dir=str(tmp_path),
+                              min_dump_interval_s=0.0)
+    watchdog = Watchdog(poll_s=0.05, default_deadline_s=30.0)
+
+    def exploding_policy(images):
+      raise RuntimeError("device fell over")
+
+    actor = VectorActor(exploding_policy, TransitionQueue(64),
+                        image_size=8, num_envs=2, seed=0,
+                        flight_recorder=recorder, watchdog=watchdog)
+    actor.start()
+    deadline = time_lib.time() + 10
+    while not actor.errors and time_lib.time() < deadline:
+      time_lib.sleep(0.02)
+    actor._thread.join(10)
+    assert actor.errors
+    dumps = [name for name in os.listdir(tmp_path)
+             if "actor_thread_exception" in name]
+    assert dumps, os.listdir(tmp_path)
+    # The heartbeat was registered on the INJECTED watchdog and
+    # unregistered when the thread died.
+    assert watchdog.snapshot()["components"] == {}
+
+  def test_trigger_context_lands_top_level(self, tmp_path):
+    recorder = FlightRecorder(dump_dir=str(tmp_path),
+                              min_dump_interval_s=0.0)
+    path = recorder.trigger("slo_breach", slo_class="batch",
+                            shed_reason="capacity", request_id="req-9")
+    with open(path) as f:
+      payload = json.load(f)
+    assert payload["request_id"] == "req-9"
+    assert payload["trigger"] == {
+        "slo_class": "batch", "shed_reason": "capacity",
+        "request_id": "req-9"}
+
+
+def _assert_fleetobs_schema(results, committed: bool):
+  """The FLEETOBS_r13 contract shared by the CLI run and the committed
+  artifact."""
+  assert results["round"] == 13
+  assert results["schema"] == "t2r-fleetobs-1"
+  assert results["virtual_mesh"] is True
+  workers = results["workers"]
+  assert len(workers) >= 2
+  assert len({worker["pid"] for worker in workers}) == len(workers)
+  fleet = results["fleet"]
+  assert fleet["hosts_merged"] >= len(workers)
+  worker_pids = {worker["pid"] for worker in workers}
+  stream_pids = {entry["pid"] for entry in fleet["per_host"].values()}
+  assert worker_pids <= stream_pids
+  for entry in fleet["per_host"].values():
+    if entry["pid"] in worker_pids:
+      assert entry["step_series"], entry
+  slo = fleet["slo"]
+  assert slo["consistent"] is True
+  assert slo["shed_total"] >= sum(worker["shed"] for worker in workers)
+  for class_entry in slo["per_class"].values():
+    assert class_entry["shed"] == (class_entry["shed_expired"]
+                                   + class_entry["shed_capacity"])
+  trace = fleet["trace"]
+  assert trace["linked_serve_timelines"] >= 1
+  assert trace["example_timeline"]["spans"][0] == "serve/enqueue"
+  assert {"serve/flush", "serve/dispatch"} <= set(
+      trace["example_timeline"]["spans"])
+  assert len(trace["sources"]) >= len(workers)
+  watchdog = results["watchdog"]
+  assert watchdog["injected_stall"]["ok"] is True
+  assert watchdog["injected_stall"]["dump_schema"] == "t2r-flightrec-1"
+  assert watchdog["healthy_control"]["ok"] is True
+  assert watchdog["healthy_control"]["events"] == 0
+  reasons = fleet["flightrec"]["reasons"]
+  assert reasons.get("watchdog_stall", 0) >= 1
+  assert reasons.get("slo_breach", 0) >= 1
+  if committed:
+    assert all(worker["devices"] == 8 for worker in workers)
+
+
+@pytest.fixture(scope="module")
+def fleetobs_results(tmp_path_factory):
+  """ONE obs_aggregate --ci run (the FLEETOBS protocol, reduced):
+  REAL subprocess workers against a shared logdir, merged + self-
+  checked — the committed-artifact pipeline under test."""
+  import subprocess
+  import sys
+  tmp = tmp_path_factory.mktemp("fleetobs")
+  logdir = tmp / "shared"
+  out = tmp / "fleetobs.json"
+  env = dict(os.environ)
+  env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+  res = subprocess.run(
+      [sys.executable, "-m", "tensor2robot_tpu.bin.obs_aggregate",
+       "--ci", "--logdir", str(logdir), "--out", str(out)],
+      capture_output=True, text=True, timeout=900, env=env, cwd=ROOT)
+  assert res.returncode == 0, res.stderr[-2000:]
+  lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+  assert len(lines) == 1, res.stdout  # the ONE-JSON-line contract
+  results = json.loads(lines[0])
+  assert json.loads(out.read_text()) == results
+  return results, str(logdir)
+
+
+class TestFleetObsCLI:
+
+  def test_schema_and_self_checks(self, fleetobs_results):
+    results, _ = fleetobs_results
+    _assert_fleetobs_schema(results, committed=False)
+
+  def test_merged_trace_file_parses_with_flows(self, fleetobs_results):
+    results, logdir = fleetobs_results
+    path = os.path.join(logdir, results["fleet"]["trace"]["file"])
+    assert os.path.exists(path)
+    with open(path) as f:
+      merged = json.load(f)["traceEvents"]
+    lanes = [e for e in merged if e.get("ph") == "M"]
+    assert len(lanes) >= 2  # one host-prefixed lane per process
+    assert any(e.get("cat") == "request" for e in merged)
+
+  def test_plain_aggregation_cli_over_existing_logdir(
+      self, fleetobs_results, tmp_path):
+    """The non-smoke CLI mode: point --logdir at the protocol's shared
+    dir and get the same merge (idempotent re-aggregation)."""
+    import subprocess
+    import sys
+    results, logdir = fleetobs_results
+    out = tmp_path / "again.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "tensor2robot_tpu.bin.obs_aggregate",
+         "--logdir", logdir, "--out", str(out)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert res.returncode == 0, res.stderr[-2000:]
+    again = json.loads(out.read_text())
+    fleet = results["fleet"]
+    assert again["hosts_merged"] == fleet["hosts_merged"]
+    assert again["slo"] == fleet["slo"]
+    assert again["registry"]["counters"] == fleet["registry"]["counters"]
+
+
+class TestCommittedFleetObsArtifact:
+
+  def test_fleetobs_r13_json_matches_schema(self):
+    path = os.path.join(ROOT, "FLEETOBS_r13.json")
+    assert os.path.exists(path), "committed FLEETOBS_r13.json missing"
+    with open(path) as f:
+      results = json.loads(f.read().strip())
+    _assert_fleetobs_schema(results, committed=True)
