@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 13
+ROUND = 14
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -1025,6 +1025,27 @@ def _bench_obs_compact():
                      serve_duration_s=1.0)
 
 
+def _bench_precision_compact():
+  """Precision-tier block for the bench detail (ISSUE 13).
+
+  The committed chipless artifact (PRECISION_r14.json) carries the
+  full parity protocol — selected-action q-agreement across the bucket
+  ladder on a trained critic, fused-loop TD bars per tier, the
+  per-tier exactly-once ledger, and the bf16-tier rollout gate — where
+  bf16 is CPU-emulated and the compact speedup is honestly null. This
+  block is the driver-refreshable real-chip counterpart: a reduced run
+  of the same phases on the window's devices, where
+  `cem_bf16_speedup` becomes a measured MXU number (bf16 matmuls on
+  the native path vs the f32 oracle executables) — the queued
+  measurement ISSUE 13 lands when the pool returns.
+  """
+  from tensor2robot_tpu.replay.precision_bench import measure_precision
+  return measure_precision(
+      buckets=(1, 2, 4, 8), corpus_scenes=32, pretrain_steps=150,
+      loop_steps=60, rollout_min_shadow=6, rollout_min_canary=3,
+      rollout_cycle_s=60.0, enforce_bars=False)
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1186,6 +1207,11 @@ def main() -> None:
   except Exception as e:
     obs = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    precision = _bench_precision_compact()
+  except Exception as e:
+    precision = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1246,6 +1272,7 @@ def main() -> None:
       "anakin": anakin,
       "anakin_multichip": anakin_multichip,
       "obs": obs,
+      "precision": precision,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1297,6 +1324,15 @@ def main() -> None:
           "hosts_merged"),
       "watchdog_stalls": obs.get("watchdog", {}).get(
           "injected_stall", {}).get("events"),
+      # Precision-tier sentinels (ISSUE 13): the bf16 tier's
+      # selected-action q-agreement vs the f32 oracle (meaningful on
+      # any backend — numerics, not timing) and its measured scoring
+      # speedup (a CHIP claim: null on a virtual mesh by the block's
+      # own honesty rule, measured on a real window). Null-safe under
+      # outage/error like every compact key.
+      "cem_bf16_action_agreement": precision.get(
+          "cem_bf16_action_agreement"),
+      "cem_bf16_speedup": precision.get("cem_bf16_speedup"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,
   }))

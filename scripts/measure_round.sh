@@ -65,6 +65,20 @@
 #                                and the watchdog stall/negative
 #                                controls; normally builder-committed
 #                                and skipped)
+#   PRECISION_r0N.json           replay/precision_bench --smoke
+#                                (CHIPLESS backstop too — ISSUE 13:
+#                                bf16-vs-f32 selected-action
+#                                q-agreement across the bucket ladder
+#                                on a trained critic, fused-loop TD
+#                                bars per tier, the per-tier
+#                                exactly-once compile ledger, and the
+#                                bf16-tier shadow/canary promotion
+#                                gate with an injected-breach
+#                                auto-rollback; bf16 is CPU-emulated
+#                                chipless, so the speedup key is null
+#                                — real-chip rates land via bench.py's
+#                                precision block; normally
+#                                builder-committed and skipped)
 #   BENCH_DETAIL_r0N.json        bench.py (orchestrator; also emits the
 #                                compact line, saved to BENCH_builder_r0N.json)
 #   SERVING_r0N.json             bin/bench_serving single-robot + --fleet lines
@@ -213,6 +227,22 @@ else
   done
   run_stage "FLEETOBS_${RTAG}.json" 1800 sh -c '
     python -m tensor2robot_tpu.bin.obs_aggregate --smoke \
+      --out "$STAGE_TMP"'
+fi
+# Sixth chipless backstop (ISSUE 13): the precision-tier protocol —
+# bf16-vs-f32 parity bars, per-tier ledger, and the bf16-tier rollout
+# gate. Same tmp→mv atomicity and pytest deferral rules (its scoring
+# rates and rollout latency bars are timing measurements).
+if [ -s "PRECISION_${RTAG}.json" ]; then
+  log "skip PRECISION_${RTAG}.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring precision backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "PRECISION_${RTAG}.json" 3000 sh -c '
+    python -m tensor2robot_tpu.replay.precision_bench --smoke \
       --out "$STAGE_TMP"'
 fi
 while [ "$(date +%s)" -lt "$deadline" ]; do

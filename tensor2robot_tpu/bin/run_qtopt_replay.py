@@ -122,7 +122,7 @@ def parse_mesh(spec: str):
 
 def build_config(smoke: bool, seed: int, device_resident: bool = False,
                  vector_actors: bool = False, anakin: bool = False,
-                 mesh=(0, 1), profile_window=None):
+                 mesh=(0, 1), profile_window=None, precision: str = "f32"):
   from tensor2robot_tpu.replay.loop import ReplayLoopConfig
   dp, tp = mesh
   if smoke:
@@ -136,7 +136,8 @@ def build_config(smoke: bool, seed: int, device_resident: bool = False,
                             vector_actors=vector_actors, anakin=anakin,
                             envs_per_collector=up(4), batch_size=up(32),
                             capacity=up(512), mesh_dp=dp, mesh_tp=tp,
-                            profile_window=profile_window)
+                            profile_window=profile_window,
+                            precision=precision)
   return ReplayLoopConfig(
       image_size=64, batch_size=32, capacity=50_000, min_fill=2_000,
       num_buffer_shards=4, num_collectors=4, envs_per_collector=8,
@@ -146,17 +147,18 @@ def build_config(smoke: bool, seed: int, device_resident: bool = False,
       device_resident=device_resident, megastep_inner=50,
       ingest_chunk=256, vector_actors=vector_actors, anakin=anakin,
       anakin_inner=200, anakin_bank_scenes=4096, mesh_dp=dp, mesh_tp=tp,
-      profile_window=profile_window)
+      profile_window=profile_window, precision=precision)
 
 
 def run(steps: int, smoke: bool, logdir: str, seed: int,
         device_resident: bool = False, learner_bench: bool = True,
         vector_actors: bool = False, actor_bench: bool = True,
         anakin: bool = False, anakin_bench: bool = True,
-        mesh=(0, 1), profile_window=None) -> dict:
+        mesh=(0, 1), profile_window=None, precision: str = "f32") -> dict:
   from tensor2robot_tpu.replay.loop import ReplayTrainLoop
   config = build_config(smoke, seed, device_resident, vector_actors,
-                        anakin, mesh=mesh, profile_window=profile_window)
+                        anakin, mesh=mesh, profile_window=profile_window,
+                        precision=precision)
   model = None  # default: the flagship QTOptGraspingModel
   if smoke:
     # CI-scale critic (replay/smoke.py): the flagship's conv tower
@@ -259,6 +261,15 @@ def main(argv=None) -> None:
                            "(default: the mode's single-mesh default; "
                            "with --anakin this is the pod-scale "
                            "sharded configuration — ISSUE 7)")
+  parser.add_argument("--precision", default="f32",
+                      choices=("f32", "bf16"),
+                      help="CEM Q-scoring tier (ISSUE 13): f32 = the "
+                           "unchanged oracle (bit-identical lowering); "
+                           "bf16 = low-precision scoring matmuls for "
+                           "acting, Bellman labeling, and the "
+                           "collectors' CEM policy — gradients, "
+                           "optimizer state, TD priorities, and the "
+                           "eval-vs-Q* metric stay f32")
   parser.add_argument("--profile", default=None,
                       help="START,END optimizer-step window for a "
                            "jax.profiler device-trace capture into "
@@ -311,7 +322,8 @@ def main(argv=None) -> None:
                 actor_bench=not args.no_actor_bench,
                 anakin=args.anakin,
                 anakin_bench=not args.no_anakin_bench,
-                mesh=mesh, profile_window=profile_window)
+                mesh=mesh, profile_window=profile_window,
+                precision=args.precision)
   line = json.dumps(results)
   if args.out:
     with open(args.out, "w") as f:

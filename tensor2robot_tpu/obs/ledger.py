@@ -63,13 +63,15 @@ def peak_flops_for(device_kind: Optional[str]) -> Optional[float]:
 class ExecutableEntry:
   """One executable's ledger row (guarded by the owning ledger's lock)."""
 
-  __slots__ = ("name", "device", "shapes", "compiles", "dispatches",
-               "seconds", "flops_per_dispatch", "bytes_per_dispatch")
+  __slots__ = ("name", "device", "shapes", "dtype", "compiles",
+               "dispatches", "seconds", "flops_per_dispatch",
+               "bytes_per_dispatch")
 
   def __init__(self, name: str):
     self.name = name
     self.device: Optional[str] = None
     self.shapes: Optional[dict] = None
+    self.dtype: Optional[str] = None
     self.compiles = 0
     self.dispatches = 0
     self.seconds = 0.0
@@ -102,11 +104,16 @@ class ExecutableLedger:
   # -- recording -----------------------------------------------------------
 
   def register(self, name: str, compiled=None, device=None,
-               shapes: Optional[dict] = None) -> str:
+               shapes: Optional[dict] = None,
+               dtype: Optional[str] = None) -> str:
     """One compilation of ``name``; repeat registrations bump the
     compile count (the recompile regression the smokes assert against).
     ``compiled`` (an AOT executable) contributes cost_analysis
-    FLOPs/bytes; ``device`` is any str()-able placement label."""
+    FLOPs/bytes; ``device`` is any str()-able placement label.
+    ``dtype`` tags the executable's SCORING precision tier ("f32" /
+    "bf16", ISSUE 13) so ``attribution()`` can split device-time and
+    MFU per tier — an untagged row groups under "untagged" (host
+    bookkeeping executables that have no scoring tier)."""
     with self._lock:
       entry = self._entries.get(name)
       if entry is None:
@@ -116,6 +123,8 @@ class ExecutableLedger:
         entry.device = str(device)
       if shapes is not None:
         entry.shapes = dict(shapes)
+      if dtype is not None:
+        entry.dtype = str(dtype)
     if compiled is not None:
       flops, nbytes = _cost_analysis(compiled)
       with self._lock:
@@ -177,6 +186,7 @@ class ExecutableLedger:
             "name": entry.name,
             "device": entry.device,
             "shapes": entry.shapes,
+            "dtype": entry.dtype,
             "compiles": entry.compiles,
             "dispatches": entry.dispatches,
             "seconds_total": round(entry.seconds, 4),
@@ -187,12 +197,28 @@ class ExecutableLedger:
             "estimated_mfu": mfu,
         })
     shares = sum(row["device_time_share"] for row in rows)
+    # Per-tier rollup (ISSUE 13): device-time split by scoring dtype, so
+    # a mixed f32/bf16 fleet's attribution answers "where does time go,
+    # per precision" — the Gemma-style serving-tier accounting.
+    tiers: Dict[str, dict] = {}
+    for row in rows:
+      tier = tiers.setdefault(row["dtype"] or "untagged", {
+          "executables": 0, "dispatches": 0, "seconds_total": 0.0,
+          "device_time_share": 0.0})
+      tier["executables"] += 1
+      tier["dispatches"] += row["dispatches"]
+      tier["seconds_total"] += row["seconds_total"]
+      tier["device_time_share"] += row["device_time_share"]
+    for tier in tiers.values():  # one rounding step, after the sums
+      tier["seconds_total"] = round(tier["seconds_total"], 4)
+      tier["device_time_share"] = round(tier["device_time_share"], 4)
     return {
         "wall_seconds": round(wall_seconds, 4) if wall_seconds else None,
         "attributed_seconds": round(attributed, 4),
         "attributed_share": round(shares, 4),
         "device_kind": device_kind,
         "peak_flops": peak,
+        "tier_shares": tiers,
         "executables": rows,
         "note": (
             "device_time_share = measured dispatch seconds / "

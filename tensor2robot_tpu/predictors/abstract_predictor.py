@@ -76,8 +76,23 @@ class AbstractPredictor(abc.ABC):
         f"{type(self).__name__} does not support init_randomly.")
 
   def set_variables(self, variables,
-                    version: Optional[int] = None) -> None:
+                    version: Optional[int] = None,
+                    cast: bool = False) -> None:
     """Hot-swaps the served params in place (same tree structure/shapes).
+
+    `cast` is the explicit precision-cast seam (ISSUE 13): a candidate
+    whose leaves arrive at a different floating dtype than the served
+    tree (e.g. a bf16-exported checkpoint promoted onto an f32-serving
+    predictor, or vice versa) is REJECTED by default — the fleet's AOT
+    executables were compiled against the live avals, and a silent
+    dtype change would fail every replica's next flush. Passing
+    cast=True declares the drift intentional: implementations cast the
+    candidate onto the LIVE tree's dtypes before installing it, so the
+    served avals (and therefore every compiled consumer) are untouched
+    while the candidate's VALUES land. Note the scoring-precision tier
+    itself never needs this — bf16 scoring quantizes inside the tier's
+    executables (cem.cast_scoring_variables) and the master params stay
+    f32; the seam exists for params that were ALREADY cast on disk.
 
     The rollout controller's promotion path (serving/rollout.py): a
     canary-validated candidate cuts over by swapping the variables the

@@ -94,13 +94,23 @@ class CheckpointPredictor(AbstractPredictor):
       self._predict = self._build_predict()
 
   def set_variables(self, variables,
-                    version: Optional[int] = None) -> None:
+                    version: Optional[int] = None,
+                    cast: bool = False) -> None:
     """See AbstractPredictor.set_variables: the rollout promotion path.
     Structure must match the loaded tree — a mismatched candidate must
     fail HERE (actionable), not as a shape error inside some replica's
     next flush. Pass the candidate's export step as `version` so a
     later restore() poll cannot mistake an older on-disk checkpoint
-    for news."""
+    for news.
+
+    cast=True is the intentional precision-cast seam (ISSUE 13): a
+    dtype-drifted candidate (e.g. bf16-exported params promoted onto
+    this f32-serving predictor) is cast leaf-by-leaf onto the LIVE
+    tree's dtypes before installing, so the served avals — and every
+    replica's compiled bucket executable — are untouched while the
+    candidate's values land. Without it, dtype drift rejects exactly
+    as before (an unintentional cast is a fleet-wide aval mismatch
+    waiting to happen)."""
     self.assert_is_loaded()
 
     def check(old, new):
@@ -113,11 +123,28 @@ class CheckpointPredictor(AbstractPredictor):
       old_dtype = np.asarray(old).dtype
       new_dtype = np.asarray(new).dtype
       if old_dtype != new_dtype:
+        # jnp.issubdtype, not np: bfloat16 is an ml_dtypes extension
+        # numpy's floating hierarchy does not recognize.
+        floating = (jax.numpy.issubdtype(old_dtype, jax.numpy.floating)
+                    and jax.numpy.issubdtype(new_dtype,
+                                             jax.numpy.floating))
+        if cast and floating:
+          # The explicit seam: candidate values at the live avals.
+          # Scoped to floating->floating — the documented precision
+          # drift. A non-float mismatch (an int counter arriving as
+          # float, a uint8 table as f32) is STRUCTURAL drift; casting
+          # it would silently truncate/wrap values fleet-wide, so it
+          # rejects below regardless of `cast`.
+          return jax.numpy.asarray(new).astype(old_dtype)
         raise ValueError(
             f"hot-swap dtype mismatch: {old_dtype} -> {new_dtype} "
             "(the fleet's AOT executables were compiled against the "
             "old avals; a dtype change would fail every replica's "
-            "next flush — promote via a new export instead).")
+            "next flush — promote via a new export"
+            + (", or pass cast=True for an intentional precision "
+               "cast onto the served dtypes" if floating else
+               "; a non-floating mismatch is structural drift the "
+               "cast seam refuses") + ").")
       return new
 
     checked = jax.tree_util.tree_map(check, self._variables, variables)

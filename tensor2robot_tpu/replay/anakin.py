@@ -131,7 +131,15 @@ class AnakinLoop(TargetNetwork):
       seed: int = 0,
       polyak_tau: Optional[float] = None,
       ledger: Optional[obs_ledger.ExecutableLedger] = None,
+      precision: str = "f32",
   ):
+    """`precision` (ISSUE 13, cem.SCORING_PRECISIONS) is the CEM
+    Q-scoring tier INSIDE the fused executable: acting's score calls
+    and the label stage's target-net max run at the tier; the env step,
+    replay extend, gradients, optimizer state, and the TD-priority
+    arithmetic (the learn body's fresh-params forward) stay f32 — the
+    low-precision-matmuls / f32-updates convention. "f32" (default)
+    lowers the program bit-identically to r10."""
     if inner_steps < 1 or train_every < 1 or inner_steps % train_every:
       raise ValueError(
           f"inner_steps {inner_steps} must be a positive multiple of "
@@ -189,9 +197,11 @@ class AnakinLoop(TargetNetwork):
     self._seed = seed
     self._clip_targets = getattr(model, "loss_type",
                                  "cross_entropy") == "cross_entropy"
-    # CEM scoring precision (detail["anakin"]["dtype"]; the bf16 tier
-    # of ROADMAP item 5 lands against this field).
-    self.dtype = "float32"
+    # CEM scoring precision (ISSUE 13, the ROADMAP item 3 bf16 tier):
+    # `precision` is the policy knob, `dtype` the jnp name surfaced in
+    # detail["anakin"]["dtype"] / the smoke artifact.
+    self.precision = cem.validate_precision(precision)
+    self.dtype = jnp.dtype(cem.scoring_dtype(precision)).name
     self.compile_counts: Dict[str, int] = {}
     self._ledger = ledger
     self._exec = None
@@ -237,10 +247,14 @@ class AnakinLoop(TargetNetwork):
     sample = self._buffer.sample_fn()
     update_priorities = self._buffer.update_priorities_fn()
     factored = getattr(model, "factored_cem_fns", lambda: None)()
+    # The label stage's CEM max runs at the scoring tier; the learn
+    # body's grads/optimizer/TD-priority forward stay f32 (the targets
+    # come back f32 from q_value_from_logits — see
+    # make_bellman_targets_fn's precision contract).
     targets_fn = make_bellman_targets_fn(
         model, self._action_size, self._gamma, self._num_samples,
         self._num_elites, self._iterations, self._clip_targets,
-        factored=factored is not None)
+        factored=factored is not None, precision=self.precision)
     # Data-parallel pins for the multi-device mesh. All three are None/
     # identity on the 1-device mesh, so the single-device program — the
     # semantics oracle and measured fallback — lowers exactly as in r09.
@@ -280,6 +294,7 @@ class AnakinLoop(TargetNetwork):
                       num_elites=self._num_elites,
                       iterations=self._iterations)
     action_size = self._action_size
+    precision = self.precision
     act_base = jax.random.key(self._seed + 7)
     explore_base = jax.random.key(self._seed + 555)
     env_base = jax.random.key(self._seed + 31)
@@ -293,9 +308,10 @@ class AnakinLoop(TargetNetwork):
               jax.random.fold_in(act_base, tick), j))(
                   jnp.arange(n, dtype=jnp.uint32))
       states, score = make_cem_states_and_score(model, factored,
-                                                online_variables, obs)
+                                                online_variables, obs,
+                                                precision=precision)
       best, _ = cem.fleet_cem_optimize(score, states, keys, action_size,
-                                       **cem_kwargs)
+                                       precision=precision, **cem_kwargs)
       # The collectors' exploration recipe (actor.py VectorActor
       # step_once): one epsilon draw per env, uniform actions, scripted
       # near-object grasps from the oracle pose — same fractions and
@@ -405,6 +421,7 @@ class AnakinLoop(TargetNetwork):
         self._ledger.register(
             "anakin_step", compiled=self._exec,
             device=f"mesh{dict(self.mesh.shape)}",
+            dtype=self.precision,
             shapes={"inner_steps": self.inner_steps,
                     "fleet": self._env.num_envs,
                     "batch": self._buffer.sample_batch_size})

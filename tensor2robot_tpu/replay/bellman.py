@@ -47,7 +47,8 @@ def q_value_from_logits(logits: jnp.ndarray,
   return jax.nn.sigmoid(logits) if clip_targets else logits
 
 
-def make_cem_states_and_score(model, fns, variables, images):
+def make_cem_states_and_score(model, fns, variables, images,
+                              precision: str = "f32"):
   """The ONE CEM scoring recipe: (states, score_fn) for
   fleet_cem_optimize, tiled or factored.
 
@@ -57,10 +58,28 @@ def make_cem_states_and_score(model, fns, variables, images):
   the tiled contract in one consumer but not the other. `fns` is the
   model's `factored_cem_fns()` result (None → tiled: score full images
   through predict_fn; (encode_fn, q_from_code_fn) → encode each image
-  once and score codes)."""
+  once and score codes).
+
+  `precision` is the scoring tier (cem.SCORING_PRECISIONS). "f32"
+  returns the exact pre-tier recipe. "bf16" runs the whole score path —
+  the factored encode included, so the hoisted image tower enjoys the
+  same low-precision matmuls the tiled path gets — at bfloat16, with
+  the per-candidate scores cast back to float32 before elite selection
+  (cem.make_tiled_q_score_fn's contract)."""
   if fns is None:
-    return images, cem.make_tiled_q_score_fn(model.predict_fn, variables)
+    return images, cem.make_tiled_q_score_fn(model.predict_fn, variables,
+                                             precision=precision)
   encode_fn, q_from_code_fn = fns
+  if cem.validate_precision(precision) != "f32":
+    # Encode once at the scoring dtype: the code then rides the tiled
+    # score's "image" key already in bf16 (its floating-input cast is a
+    # no-op), identical Q function and search to the tiled bf16 form.
+    lp_variables = cem.cast_scoring_variables(variables, precision)
+    states = encode_fn(
+        lp_variables,
+        {"image": images.astype(cem.scoring_dtype(precision))})
+    return states, cem.make_tiled_q_score_fn(q_from_code_fn, variables,
+                                             precision=precision)
   return (encode_fn(variables, {"image": images}),
           cem.make_tiled_q_score_fn(q_from_code_fn, variables))
 
@@ -68,7 +87,8 @@ def make_cem_states_and_score(model, fns, variables, images):
 def make_bellman_targets_fn(model, action_size: int, gamma: float,
                             num_samples: int, num_elites: int,
                             iterations: int, clip_targets: bool,
-                            factored: bool = False):
+                            factored: bool = False,
+                            precision: str = "f32"):
   """THE Bellman target body, as one pure jittable closure.
 
   (target_variables, next_images, rewards, dones, keys) ->
@@ -87,7 +107,15 @@ def make_bellman_targets_fn(model, action_size: int, gamma: float,
   (the fused Anakin loop's configuration; equivalence to the tiled
   recipe is property-tested in tests/test_anakin.py). The default
   stays the tiled score: the one contract every learner shares.
+
+  precision (cem.SCORING_PRECISIONS): the Q-scoring tier of the CEM
+  max. Only the target-net forward inside the search runs at the tier
+  — q_value_from_logits casts the best logits to float32, so the
+  Bellman arithmetic (reward add, gamma discount, done mask, the clip)
+  and everything downstream (grads, optimizer, TD priorities) stays
+  f32 under every tier.
   """
+  cem.validate_precision(precision)
   fns = model.factored_cem_fns() if factored else None
   if factored and fns is None:
     raise ValueError(
@@ -97,11 +125,12 @@ def make_bellman_targets_fn(model, action_size: int, gamma: float,
   def targets_fn(target_variables, next_images, rewards, dones, keys):
     states, score = make_cem_states_and_score(model, fns,
                                               target_variables,
-                                              next_images)
+                                              next_images,
+                                              precision=precision)
     _, best_logits = cem.fleet_cem_optimize(
         score, states, keys, action_size,
         num_samples=num_samples, num_elites=num_elites,
-        iterations=iterations)
+        iterations=iterations, precision=precision)
     q_next = q_value_from_logits(best_logits, clip_targets)
     targets = (rewards.astype(jnp.float32)
                + gamma * (1.0 - dones.astype(jnp.float32)) * q_next)
@@ -182,6 +211,7 @@ class BellmanUpdater(TargetNetwork):
       seed: int = 0,
       polyak_tau: Optional[float] = None,
       ledger: Optional[obs_ledger.ExecutableLedger] = None,
+      precision: str = "f32",
   ):
     """Args:
       model: a CriticModel (loss_type decides target value space: the
@@ -196,8 +226,14 @@ class BellmanUpdater(TargetNetwork):
         config here too).
       polyak_tau: None = hard copy on refresh(); else
         target <- tau * online + (1 - tau) * target per refresh call.
+      precision: the CEM Q-scoring tier for compute_targets
+        (cem.SCORING_PRECISIONS; "f32" = the unchanged oracle). The TD
+        executable (td_errors — priorities AND the eval-vs-analytic-Q*
+        metric) deliberately stays f32 under every tier: priorities and
+        eval bars are f32-updates territory, not scoring.
     """
     super().__init__(variables, polyak_tau=polyak_tau)
+    self.precision = cem.validate_precision(precision)
     self._model = model
     self._action_size = action_size
     self._gamma = gamma
@@ -226,7 +262,8 @@ class BellmanUpdater(TargetNetwork):
     # updater only adds its uint32-counter → key fold in front.
     targets_fn = make_bellman_targets_fn(
         self._model, self._action_size, self._gamma, self._num_samples,
-        self._num_elites, self._iterations, self._clip_targets)
+        self._num_elites, self._iterations, self._clip_targets,
+        precision=self.precision)
 
     def seeded_targets_fn(target_variables, next_images, rewards, dones,
                           seeds):
@@ -249,17 +286,19 @@ class BellmanUpdater(TargetNetwork):
 
     return td_fn
 
-  def _compile(self, name: str, fn, args):
+  def _compile(self, name: str, fn, args, dtype: Optional[str] = None):
     """AOT lower+compile at the args' (fixed) shapes, ledger bumped.
 
     AOT executables REJECT any later shape drift instead of silently
     recompiling — the ledger plus this hard failure is what makes
-    "compiles exactly once" an enforced property, not a hope.
+    "compiles exactly once" an enforced property, not a hope. `dtype`
+    tags the ledger row with the executable's scoring tier so
+    attribution can split device time per precision.
     """
     executable = jax.jit(fn).lower(*args).compile()
     self.compile_counts[name] = self.compile_counts.get(name, 0) + 1
     if self._ledger is not None:
-      self._ledger.register(name, compiled=executable)
+      self._ledger.register(name, compiled=executable, dtype=dtype)
     return executable
 
   def compute_targets(
@@ -288,7 +327,8 @@ class BellmanUpdater(TargetNetwork):
     args = (self._target_variables, next_images, rewards, dones, seeds)
     if self._targets_exec is None:
       self._targets_exec = self._compile(
-          "bellman_targets", self._build_targets_fn(), args)
+          "bellman_targets", self._build_targets_fn(), args,
+          dtype=self.precision)
     start = time.perf_counter()
     targets, q_next = self._targets_exec(*args)
     targets, q_next = np.asarray(targets), np.asarray(q_next)
@@ -310,7 +350,8 @@ class BellmanUpdater(TargetNetwork):
     targets = jnp.asarray(targets)
     args = (variables, images, actions, targets)
     if self._td_exec is None:
-      self._td_exec = self._compile("td_error", self._build_td_fn(), args)
+      self._td_exec = self._compile("td_error", self._build_td_fn(), args,
+                                    dtype="f32")
     start = time.perf_counter()
     td = np.asarray(self._td_exec(*args))
     if self._ledger is not None:

@@ -680,12 +680,21 @@ class MegastepLearner(TargetNetwork):
       seed: int = 0,
       polyak_tau: Optional[float] = None,
       ledger: Optional[obs_ledger.ExecutableLedger] = None,
+      precision: str = "f32",
   ):
+    """`precision` (ISSUE 13, cem.SCORING_PRECISIONS) is the Q-scoring
+    tier of the fused label stage: the CEM target max inside the scan
+    runs at the tier, while the train body's grads/optimizer and the
+    fresh-params TD forward that drives priorities stay f32 (targets
+    re-enter the learn body as float32). "f32" lowers the megastep
+    bit-identically to the pre-tier program."""
     if inner_steps < 1:
       raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
     # Cold target net: the first refresh() hard-copies regardless of
     # polyak_tau (TargetNetwork semantics).
     super().__init__(polyak_tau=polyak_tau)
+    from tensor2robot_tpu.research.qtopt import cem as cem_lib
+    self.precision = cem_lib.validate_precision(precision)
     self._model = model
     self._trainer = trainer
     self._buffer = buffer
@@ -715,7 +724,8 @@ class MegastepLearner(TargetNetwork):
     # megastep compiles the identical recipe the host updater AOTs.
     targets_fn = make_bellman_targets_fn(
         model, self._action_size, self._gamma, self._num_samples,
-        self._num_elites, self._iterations, self._clip_targets)
+        self._num_elites, self._iterations, self._clip_targets,
+        precision=self.precision)
     batch_size = self._buffer.sample_batch_size
     clip = self._clip_targets
     k = self.inner_steps
@@ -776,6 +786,7 @@ class MegastepLearner(TargetNetwork):
         self._ledger.register(
             "megastep", compiled=self._exec,
             device=f"mesh{dict(self._trainer.mesh.shape)}",
+            dtype=self.precision,
             shapes={"inner_steps": self.inner_steps,
                     "batch": self._buffer.sample_batch_size})
     return self._exec

@@ -339,6 +339,17 @@ class ReplayLoopConfig:
   mesh_dp: int = 0
   mesh_tp: int = 1
   zero1: Optional[bool] = None
+  # CEM Q-scoring precision tier (ISSUE 13, cem.SCORING_PRECISIONS):
+  # "f32" (default, the oracle — every path lowers exactly as r10) or
+  # "bf16" (low-precision scoring matmuls for acting, Bellman labeling,
+  # and the collectors' CEM policy; gradients, optimizer state, and
+  # TD-priority arithmetic stay f32). Threaded into the host
+  # BellmanUpdater's label path, the MegastepLearner's fused label
+  # stage, the AnakinLoop's fused acting+labeling, and the collector
+  # CEMFleetPolicy. The eval-vs-analytic-Q* TD metric is f32 on every
+  # path (BellmanUpdater.td_errors — f32-updates territory), so the
+  # TD-reduction bar compares tiers against ONE oracle metric.
+  precision: str = "f32"
   # Windowed device-trace capture (ISSUE 11 satellite): (start, end)
   # OPTIMIZER steps handed to utils.profiling.ProfilerHook — the same
   # windowed jax.profiler capture train_eval runs, now available on
@@ -369,7 +380,10 @@ class ReplayTrainLoop:
     from tensor2robot_tpu.train.trainer import Trainer
     from tensor2robot_tpu.utils.metric_writer import MetricWriter
 
+    from tensor2robot_tpu.research.qtopt import cem as cem_lib
+
     self.config = config
+    cem_lib.validate_precision(config.precision)  # fail at construction
     self.logdir = logdir
     self.model = model if model is not None else self._default_model()
     # Observability spine (ISSUE 11): one ExecutableLedger per loop run
@@ -484,7 +498,7 @@ class ReplayTrainLoop:
         predictor, action_size=c.action_size,
         num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
         iterations=c.cem_iterations, seed=c.seed + 7, ladder=ladder,
-        ledger=self.obs_ledger)
+        ledger=self.obs_ledger, precision=c.precision)
 
   def _eval_transitions(self):
     """Held-out random-action eval set WITH its analytic value targets.
@@ -662,6 +676,7 @@ class ReplayTrainLoop:
         "env_steps_collected": sum(c_.env_steps
                                    for c_ in self._collectors),
         "vector_actors": self.config.vector_actors,
+        "precision": self.config.precision,
         "collector_success_rate": (
             sum(c_.successes for c_ in self._collectors)
             / max(1, sum(c_.episodes for c_ in self._collectors))),
@@ -717,12 +732,16 @@ class ReplayTrainLoop:
 
     predictor = _HotReloadPredictor(self.model, host_variables)
     policy = self._make_policy(predictor)
+    # The host path's ONE updater both labels (compute_targets — runs
+    # at the configured scoring tier) and evaluates (td_errors — f32 on
+    # every tier by the updater's precision contract).
     updater = BellmanUpdater(
         self.model, host_variables, action_size=c.action_size,
         gamma=c.gamma,
         num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
         iterations=c.cem_iterations, seed=c.seed + 13,
-        polyak_tau=c.polyak_tau, ledger=self.obs_ledger)
+        polyak_tau=c.polyak_tau, ledger=self.obs_ledger,
+        precision=c.precision)
 
     self._start_collectors(policy)
     profile_hook = self._profile_hook()
@@ -850,7 +869,8 @@ class ReplayTrainLoop:
         action_size=c.action_size, gamma=c.gamma,
         num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
         iterations=c.cem_iterations, inner_steps=k, seed=c.seed + 13,
-        polyak_tau=c.polyak_tau, ledger=self.obs_ledger)
+        polyak_tau=c.polyak_tau, ledger=self.obs_ledger,
+        precision=c.precision)
     # Cold-start target = initial online copy (BellmanUpdater parity);
     # this counts as refresh 0, not a loop refresh.
     learner.refresh(host_variables, step=0)
@@ -972,7 +992,8 @@ class ReplayTrainLoop:
         train_every=c.anakin_train_every, min_fill=c.min_fill,
         exploration_epsilon=c.exploration_epsilon,
         scripted_fraction=c.scripted_fraction, seed=c.seed + 13,
-        polyak_tau=c.polyak_tau, ledger=self.obs_ledger)
+        polyak_tau=c.polyak_tau, ledger=self.obs_ledger,
+        precision=c.precision)
     loop.refresh(host_variables, step=0)
     profile_hook = self._profile_hook()
 

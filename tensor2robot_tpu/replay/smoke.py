@@ -58,17 +58,37 @@ class _TinyQModule(nn.Module):
     self.q_head = nn.Dense(1)
 
   def encode(self, features) -> jnp.ndarray:
-    """(B, S, S, 3) uint8 image wire → (B, 32) position code."""
-    image = features["image"].astype(jnp.float32) / 255.0
+    """(B, S, S, 3) uint8 image wire → (B, 32) position code.
+
+    Dtype discipline (ISSUE 13): the uint8 wire normalizes to float32
+    exactly as before (the f32 oracle path lowers bit-identically), but
+    a FLOATING image — the bf16 scoring tier's boundary cast
+    (cem.make_tiled_q_score_fn) — keeps its dtype, so flax promotion
+    (Dense layers here carry no forced dtype) runs the whole tower's
+    matmuls at the scoring precision. 0..255 is exact in bf16's 8-bit
+    significand, so the bf16 normalize sees the same integers."""
+    image = features["image"]
+    if not jnp.issubdtype(image.dtype, jnp.floating):
+      image = image.astype(jnp.float32)
+    image = image / jnp.asarray(255.0, image.dtype)
     x = image.reshape((image.shape[0], -1))
     return self.img_code(nn.relu(self.img_fc1(x)))
 
   def q_from_code(self, features):
     """{"image": (B, 32) code, "action": (B, A)} → q logit (the
     factored-score wire: the code rides the `image` key so the tiled
-    score_fn broadcast applies to it unchanged)."""
-    action = nn.relu(self.act_fc1(features["action"].astype(jnp.float32)))
-    h = jnp.concatenate([features["image"], action], axis=-1)
+    score_fn broadcast applies to it unchanged). Floating actions keep
+    their dtype (the score boundary already cast them to the scoring
+    tier; non-floating input — never produced by the score fns — falls
+    back to f32)."""
+    action = features["action"]
+    if not jnp.issubdtype(action.dtype, jnp.floating):
+      action = action.astype(jnp.float32)
+    action = nn.relu(self.act_fc1(action))
+    code = features["image"]
+    if action.dtype != code.dtype:
+      action = action.astype(code.dtype)
+    h = jnp.concatenate([code, action], axis=-1)
     h = nn.relu(self.joint_fc1(h))
     h = nn.relu(self.joint_fc2(h))
     return ts.TensorSpecStruct({"q_predicted": self.q_head(h)[:, 0]})

@@ -9,6 +9,23 @@ TPU/JAX design: the whole loop is a `lax.fori_loop` over pure tensors —
 jit once, no per-iteration host round-trips; batched over control states
 via vmap. Scoring uses ONE batched Q call per iteration (the reference
 did the same through batched session.run).
+
+Precision tiers (ISSUE 13): Q scoring inside CEM dominates acting,
+Bellman labeling, AND serving, and ran f32 end-to-end through r13. The
+``precision`` policy ("f32" | "bf16") threads one value through the
+whole scoring stack — this module's score-fn builders, the Bellman
+target recipe (replay/bellman.py), the serving bucket executables
+(serving/policy.py), and the fused loops (replay/anakin.py,
+replay/device_buffer.py). The mixed-precision convention follows the
+pjit/TPUv4 scaling study (PAPERS.md): LOW-precision matmuls (params and
+score inputs cast to bfloat16 at the score boundary, promotion-driven
+modules compute in bf16), f32 ACCUMULATION AND UPDATES (scores return
+to f32 before elite selection, the CEM search arithmetic — Gaussian
+sampling, refit, clipping — is f32 under every tier, and gradients /
+optimizer state / TD priorities never see bf16). "f32" is the oracle
+tier: its builders return the exact pre-tier closures, so the default
+path lowers bit-identically to r10 (the unchanged-semantics acceptance
+bar).
 """
 
 from __future__ import annotations
@@ -18,6 +35,50 @@ from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+# The supported scoring tiers. f32 is the oracle (bit-identical to the
+# pre-tier lowering); bf16 is the inference tier proved safe by parity
+# bars (PRECISION_r14.json) and the shadow/canary rollout harness.
+SCORING_PRECISIONS = ("f32", "bf16")
+
+_SCORING_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+
+
+def validate_precision(precision: str) -> str:
+  """Rejects unknown tiers with the valid set named (every layer of the
+  scoring stack validates, so a typo'd tier fails at construction, not
+  as a silent f32 fallback serving mislabeled numbers)."""
+  if precision not in SCORING_PRECISIONS:
+    raise ValueError(
+        f"unknown scoring precision {precision!r}; supported tiers: "
+        f"{SCORING_PRECISIONS}")
+  return precision
+
+
+def scoring_dtype(precision: str):
+  """The jnp dtype Q-scoring matmuls run in under `precision`."""
+  return _SCORING_DTYPES[validate_precision(precision)]
+
+
+def cast_scoring_variables(variables, precision: str):
+  """A `precision`-tier view of a params pytree for Q scoring.
+
+  f32 returns the SAME object (zero ops, identity — the f32 path's
+  bit-identical-lowering contract, and the serving policies' identity-
+  keyed placed-variables cache keeps working). bf16 casts every
+  floating leaf to bfloat16 (integer leaves — step counters, uint8
+  tables — pass through); inside a jitted score program the cast is
+  part of the executable, so a served tree is quantized once per
+  dispatch, never mutated in place — the f32 master params are what
+  gradients and promotions continue to see.
+  """
+  if validate_precision(precision) == "f32":
+    return variables
+  dtype = _SCORING_DTYPES[precision]
+  return jax.tree_util.tree_map(
+      lambda leaf: leaf.astype(dtype)
+      if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating) else leaf,
+      variables)
 
 
 def cem_optimize(
@@ -97,7 +158,7 @@ def batched_cem_optimize(
       **kwargs)
 
 
-def make_tiled_q_score_fn(fn, variables):
+def make_tiled_q_score_fn(fn, variables, precision: str = "f32"):
   """The canonical per-state Q score_fn for `fleet_cem_optimize`.
 
   Tiles ONE state's image across its candidate actions and scores the
@@ -108,17 +169,43 @@ def make_tiled_q_score_fn(fn, variables):
   training targets diverging silently is the worst QT-Opt failure mode
   — so both build their score_fn here.
 
-  Image dtype passes through untouched (the model's wire format:
-  float32, or uint8 on the bandwidth-saving path).
+  precision="f32" (default) is the oracle tier: the returned closure is
+  the exact pre-tier body — image dtype passes through untouched (the
+  model's wire format: float32, or uint8 on the bandwidth-saving path),
+  actions cast f32, scores returned in the model's head dtype. The f32
+  program lowers bit-identically to r10.
+
+  precision="bf16" applies the scoring cast at THIS boundary — the one
+  place both serving and labeling already share: params' float leaves
+  to bfloat16 (`cast_scoring_variables`), the state image to bfloat16
+  BEFORE tiling (one small cast, the broadcast stays free; the uint8
+  wire's 0..255 values are exact in bf16's 8-bit significand),
+  candidate actions to bfloat16 — so promotion-driven modules run their
+  matmuls in bf16 — and the scores back to float32 before they reach
+  elite selection (f32 accumulation, the pjit/TPUv4 convention).
   """
-  def score(image, actions):
+  if validate_precision(precision) == "f32":
+    def score(image, actions):
+      tiled = jnp.broadcast_to(image[None],
+                               (actions.shape[0],) + image.shape)
+      outputs = fn(variables, {"image": tiled,
+                               "action": actions.astype(jnp.float32)})
+      return jnp.reshape(outputs["q_predicted"], (-1,))
+
+    return score
+
+  dtype = _SCORING_DTYPES[precision]
+  lp_variables = cast_scoring_variables(variables, precision)
+
+  def score_lp(image, actions):
+    image = image.astype(dtype)
     tiled = jnp.broadcast_to(image[None],
                              (actions.shape[0],) + image.shape)
-    outputs = fn(variables, {"image": tiled,
-                             "action": actions.astype(jnp.float32)})
-    return jnp.reshape(outputs["q_predicted"], (-1,))
+    outputs = fn(lp_variables, {"image": tiled,
+                                "action": actions.astype(dtype)})
+    return jnp.reshape(outputs["q_predicted"], (-1,)).astype(jnp.float32)
 
-  return score
+  return score_lp
 
 
 def fleet_cem_optimize(
@@ -126,6 +213,7 @@ def fleet_cem_optimize(
     states: jnp.ndarray,
     keys: jax.Array,
     action_size: int,
+    precision: str = "f32",
     **kwargs,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
   """CEM over a batch of states with CALLER-supplied per-state keys.
@@ -142,10 +230,20 @@ def fleet_cem_optimize(
     score_fn: (state, (N, A) actions) → (N,) scores for ONE state.
     states: (B, ...) batch of states (pytree leaves batched on axis 0).
     keys: (B,) PRNG keys, one per state.
+    precision: the scoring tier the caller built `score_fn` at
+      ("f32" | "bf16"). Validated here so one `precision` value threads
+      the whole stack and a typo fails at the optimizer call; the tier
+      itself lives in score_fn (`make_tiled_q_score_fn(precision=)`) —
+      the SEARCH arithmetic (Gaussian sampling, elite refit, clipping,
+      the final mean) is float32 under every tier by the
+      low-precision-matmuls / f32-updates convention, so candidate
+      actions and the selected action never lose precision.
 
   Returns:
     (B, A) best actions, (B,) their scores.
   """
+  validate_precision(precision)
+
   def single(state, key):
     return cem_optimize(
         functools.partial(score_fn, state), key, action_size, **kwargs)

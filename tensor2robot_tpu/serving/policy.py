@@ -46,7 +46,8 @@ class CEMFleetPolicy:
                iterations: int = 3, seed: int = 0,
                ladder: Optional[BucketLadder] = None,
                device=None,
-               ledger: Optional[ledger_lib.ExecutableLedger] = None):
+               ledger: Optional[ledger_lib.ExecutableLedger] = None,
+               precision: str = "f32"):
     """See class docstring. `device` pins this policy's executables and
     inputs to ONE jax.Device — the fleet router's replica placement
     (serving/router.py): each mesh device gets its own policy whose
@@ -57,8 +58,17 @@ class CEMFleetPolicy:
     bucket registers into (cost_analysis joined) and whose dispatch
     wall time the call path records — entries are keyed
     ``cem_bucket_<n>`` plus ``@<device>`` when pinned, so a fleet's
-    per-device replicas stay distinct rows."""
+    per-device replicas stay distinct rows.
+    `precision` (ISSUE 13) is the Q-scoring tier of every bucket
+    executable this policy compiles (cem.SCORING_PRECISIONS). One
+    policy serves ONE tier — a fleet running two tiers (the rollout
+    harness's bf16 candidate next to f32 live) builds one policy per
+    tier, and the non-f32 ledger keys carry a ``_<tier>`` suffix
+    (``cem_bucket_4_bf16@<device>``) so the fleet ledger proves
+    exactly-once compilation PER TIER, not just per bucket. The f32
+    default leaves keys and lowering exactly as r10 (the oracle)."""
     self._predictor = predictor
+    self.precision = cem.validate_precision(precision)
     self._action_size = action_size
     self._num_samples = num_samples
     self._num_elites = num_elites
@@ -94,6 +104,19 @@ class CEMFleetPolicy:
       start = self._next_seed
       self._next_seed += n
     return np.arange(start, start + n, dtype=np.uint32)
+
+  def warm(self, make_image) -> None:
+    """Compiles the full bucket ladder by scoring `make_image(i)`
+    frames at every rung (answers discarded) — THE shared warmup every
+    zero-recompile cutover rides: replica startup
+    (PolicyReplica.warmup), the fleet tier promotion
+    (FleetRouter.set_precision), and a tier-candidate offer
+    (RolloutController.offer_precision_candidate). Already-compiled
+    buckets make this a no-op walk (the memoized-policy re-offer
+    path)."""
+    for bucket in self.ladder.sizes:
+      self([make_image(i) for i in range(bucket)],
+           np.arange(bucket, dtype=np.uint32))
 
   def __call__(self, images: Sequence[np.ndarray],
                seeds: Optional[Sequence[int]] = None, *,
@@ -138,8 +161,9 @@ class CEMFleetPolicy:
     return actions[:n]
 
   def _ledger_key(self, bucket: int) -> str:
+    tier = f"_{self.precision}" if self.precision != "f32" else ""
     suffix = f"@{self.device}" if self.device is not None else ""
-    return f"cem_bucket_{bucket}{suffix}"
+    return f"cem_bucket_{bucket}{tier}{suffix}"
 
   # -- device placement ----------------------------------------------------
 
@@ -185,13 +209,16 @@ class CEMFleetPolicy:
       # the fleet vmap this becomes one (B*num_samples) Q call per
       # CEM iteration — the Podracer-style batched on-device step.
       # Shared with the Bellman updater's target max (same wire
-      # contract, by construction).
-      score = cem.make_tiled_q_score_fn(fn, variables)
+      # contract, by construction). The scoring tier is part of the
+      # compiled program (params quantize inside the executable), so a
+      # hot reload stays one device_put, zero recompiles, any tier.
+      score = cem.make_tiled_q_score_fn(fn, variables,
+                                        precision=self.precision)
 
       best, _ = cem.fleet_cem_optimize(
           score, images, keys, self._action_size,
           num_samples=num_samples, num_elites=self._num_elites,
-          iterations=self._iterations)
+          iterations=self._iterations, precision=self.precision)
       return best
 
     return control
@@ -209,7 +236,7 @@ class CEMFleetPolicy:
         if self._ledger is not None:
           self._ledger.register(
               self._ledger_key(bucket), compiled=compiled,
-              device=self.device,
+              device=self.device, dtype=self.precision,
               shapes={"bucket": bucket,
                       "num_samples": self._num_samples,
                       "iterations": self._iterations})
@@ -233,6 +260,13 @@ class CEMFleetPolicy:
     re-slicing the tiled image stack each time even when the request
     count already fit a bucket exactly.
     """
+    if self.precision != "f32":
+      raise ValueError(
+          f"precision {self.precision!r} requires the predictor's "
+          "device path (device_fn): the host fallback scores through "
+          "predictor.predict, whose compute dtype cannot be retiered "
+          "per policy. Serve the f32 tier, or use a device-resident "
+          "predictor.")
     num = self._num_samples
     n = batch.shape[0]
     bucket = self.ladder.bucket_for(n)

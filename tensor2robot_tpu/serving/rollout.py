@@ -192,10 +192,21 @@ class RolloutController:
     self._state = "serving"
     self._candidate_version = None
     self._candidate_variables = None
+    # Precision-tier candidate (ISSUE 13): when set, the shadow flushes
+    # dispatch through THIS policy (a tier-rebuilt CEMFleetPolicy on the
+    # shadow replica's device) instead of the live policy's executables,
+    # and promote flips the fleet's tier (router.set_precision) rather
+    # than the predictor's params.
+    self._candidate_policy = None
+    self._candidate_precision = None
     self._shadow_batcher: Optional[MicroBatcher] = None
     self._work: "queue.Queue" = queue.Queue()
     self._worker: Optional[threading.Thread] = None
     self._running = False
+    # Set by stop() and never cleared by it: the tier-offer warm window
+    # consults it so a stop() landing mid-warm stands the offer down
+    # instead of starting a shadow batcher nothing will ever stop.
+    self._stopped = False
     self._started_at = time.perf_counter()
     self.events: List[dict] = []
     self._reset_accumulators()
@@ -207,6 +218,7 @@ class RolloutController:
       if self._running:
         return self
       self._running = True
+      self._stopped = False
     self._worker = threading.Thread(
         target=self._run, name="rollout-controller", daemon=True)
     self._worker.start()
@@ -214,6 +226,7 @@ class RolloutController:
 
   def stop(self) -> None:
     with self._lock:
+      self._stopped = True
       if not self._running:
         return
       self._running = False
@@ -305,20 +318,108 @@ class RolloutController:
     """Starts evaluating a candidate; False if one is already in
     flight (the watcher re-offers on a later poll)."""
     with self._lock:
-      if self._state != "serving":
+      if self._state != "serving" or self._stopped:
+        # A stopped controller must never start a shadow batcher: its
+        # worker is dead, so nothing would ever decide the stage and
+        # the dispatcher thread would leak (same seam the precision
+        # offer guards).
         return False
       self._state = "shadow"
       self._candidate_version = version
       self._candidate_variables = variables
       self._reset_accumulators()
-      replica = self._router.replicas[-1]
-      self._shadow_batcher = MicroBatcher(
-          lambda items, _replica=replica: self._shadow_flush(
-              _replica, items),
-          max_batch=replica.batcher.max_batch,
-          deadline_ms=5.0).start()
+      self._start_shadow_batcher_locked()
     self._record("shadow_start", version=version)
     return True
+
+  def offer_precision_candidate(self, precision: str,
+                                version=None,
+                                variables=None) -> bool:
+    """Starts evaluating a PRECISION-TIER candidate (ISSUE 13): the
+    same serving params scored through executables compiled at
+    `precision` ("bf16") instead of the fleet's live tier — the first
+    live-traffic promotion gate for a numerics change, and the pattern
+    every future precision or kernel tier reuses.
+
+    The identical shadow→canary→promote machinery runs: mirrored pairs
+    share (image, seed) with the live answer, so the q-delta bar under
+    the serving-params oracle measures EXACTLY the numerics difference
+    (a tier that changes nothing reads near 0.0); promote calls
+    ``router.set_precision`` — every replica hot-swaps to the tier,
+    zero params touched — and auto-rollback at either stage leaves the
+    fleet on its live tier untouched.
+
+    `variables` (optional) scores the candidate tier over an explicit
+    params tree instead of the predictor's live tree — the
+    injected-breach seam: a corrupted tree through the candidate tier
+    models a broken numerics change, and the q-delta bar must
+    auto-roll it back (PRECISION_r14's proven-rollback cycle).
+    `version` defaults to the predictor's current model_version (a
+    tier change ships no new params). False when a rollout is already
+    in flight, same as offer_candidate.
+    """
+    from tensor2robot_tpu.research.qtopt import cem
+
+    cem.validate_precision(precision)
+    if precision == self._router.precision and variables is None:
+      raise ValueError(
+          f"candidate tier {precision!r} is already the fleet's "
+          "serving tier; nothing to prove")
+    # RESERVE the cycle under the lock before paying the warmup: the
+    # "warming" state rejects concurrent offers (both entry points
+    # check for "serving"), so the seconds of bucket compiles below
+    # can never run on the shadow replica's device while ANOTHER
+    # candidate's shadow phase is measuring latency pairs there.
+    # submit() routes "warming" like "serving" (no mirroring yet).
+    with self._lock:
+      if self._state != "serving" or self._stopped:
+        return False
+      self._state = "warming"
+    try:
+      # Build + WARM the tier policy before any live traffic mirrors
+      # to it (outside the lock: bucket compiles cost seconds). A
+      # params candidate shares the live replica's warmed executables,
+      # so its shadow latency is comparable from the first pair; a
+      # tier candidate has its OWN executables, and without this
+      # warmup the compile stalls land inside the mirrored latencies
+      # and flunk the latency-ratio bar on a perfectly healthy tier.
+      # router.warm_policy is the SAME build-and-warm recipe the
+      # promote path runs per replica (answers discarded; memoized
+      # policies make a re-offer's warmup a no-op walk).
+      policy = self._router.warm_policy(
+          self._router.replicas[-1].device, precision)
+    except BaseException:
+      with self._lock:
+        if self._state == "warming":
+          self._state = "serving"  # release the reservation
+      raise
+    with self._lock:
+      if self._state != "warming" or self._stopped:
+        # stop() raced the warm window: starting a shadow batcher on a
+        # stopped controller would leak its dispatcher thread and wedge
+        # the state machine — release the reservation and stand down.
+        if self._state == "warming":
+          self._state = "serving"
+        return False
+      self._state = "shadow"
+      self._candidate_version = (version if version is not None
+                                 else self._predictor.model_version)
+      self._candidate_variables = variables
+      self._candidate_precision = precision
+      self._candidate_policy = policy
+      self._reset_accumulators()
+      self._start_shadow_batcher_locked()
+    self._record("shadow_start", version=self._candidate_version,
+                 precision=precision)
+    return True
+
+  def _start_shadow_batcher_locked(self) -> None:
+    replica = self._router.replicas[-1]
+    self._shadow_batcher = MicroBatcher(
+        lambda items, _replica=replica: self._shadow_flush(
+            _replica, items),
+        max_batch=replica.batcher.max_batch,
+        deadline_ms=5.0).start()
 
   # -- status / artifact ---------------------------------------------------
 
@@ -364,7 +465,17 @@ class RolloutController:
   def _shadow_flush(self, replica, items):
     images = [item[0] for item in items]
     seeds = np.asarray([item[1] for item in items], np.uint32)
+    policy = self._candidate_policy
     variables = self._candidate_variables
+    if policy is not None:
+      # Precision-tier candidate: dispatch through the tier-rebuilt
+      # policy on this replica's device (its own executables, tier-
+      # suffixed ledger keys). `variables` rides along only on the
+      # injected-breach path; the normal tier candidate scores the
+      # predictor's LIVE params — the tier IS the change under test.
+      if variables is None:
+        return list(policy(images, seeds))
+      return list(policy(images, seeds, variables=variables))
     if variables is None:
       # Torn down with requests still queued (a promote/rollback raced
       # a canary submit; stop() drains through here). Serve them with
@@ -499,12 +610,14 @@ class RolloutController:
                     shadow_ms / max(live_ms, 1e-9)
                     <= self._config.max_latency_ratio)
       version = self._candidate_version
+      precision = self._candidate_precision
+    tier = {} if precision is None else {"precision": precision}
     # Event BEFORE the state flip: callers poll `state` to learn a
     # cycle finished, so the timeline must already carry its terminal
     # event when `state` reads "serving" (the flip is the publication
     # point; recording after it is a read-your-writes race).
     if q_ok and latency_ok:
-      self._record("canary_start", version=version, **metrics)
+      self._record("canary_start", version=version, **tier, **metrics)
       with self._lock:
         if self._state != "shadow":
           return
@@ -513,7 +626,7 @@ class RolloutController:
     else:
       self._record("auto_rollback", version=version, stage="shadow",
                    q_bar_passed=q_ok, latency_bar_passed=latency_ok,
-                   **metrics)
+                   **tier, **metrics)
       with self._lock:
         stale_batcher = self._rollback_locked()
       if stale_batcher is not None:
@@ -527,21 +640,30 @@ class RolloutController:
       metrics = dict(self._shadow_metrics(),
                      canary_pairs=self._pairs_done)
       version = self._candidate_version
+      precision = self._candidate_precision
       promote = q_delta >= -self._config.max_q_regression
       variables = self._candidate_variables if promote else None
+    tier = {} if precision is None else {"precision": precision}
     if promote:
-      # set_variables outside the lock: it device-puts the tree and
-      # must not block submit()'s state reads. The swap is atomic at
-      # the predictor (GIL pointer swap), replicas pick it up at their
-      # next flush — zero recompiles by the hot-reload contract. The
-      # candidate's version rides along so restore()'s newest-wins
-      # check can't later overwrite the promotion with an older
-      # on-disk checkpoint.
-      self._predictor.set_variables(variables, version=version)
-      self._record("promote", version=version, **metrics)
+      # set_variables / set_precision outside the lock: both touch
+      # device state and must not block submit()'s state reads. A
+      # params candidate hot-swaps the predictor's tree (atomic GIL
+      # pointer swap, replicas pick it up at their next flush — zero
+      # recompiles; the candidate's version rides along so restore()'s
+      # newest-wins check can't later overwrite the promotion with an
+      # older on-disk checkpoint). A PRECISION candidate flips the
+      # whole fleet's scoring tier instead — every replica swaps to a
+      # tier-rebuilt policy; params untouched unless the candidate
+      # carried an explicit tree (then both install, params first so
+      # the tier's first flush already serves them).
+      if variables is not None:
+        self._predictor.set_variables(variables, version=version)
+      if precision is not None:
+        self._router.set_precision(precision)
+      self._record("promote", version=version, **tier, **metrics)
     else:
       self._record("auto_rollback", version=version, stage="canary",
-                   **metrics)
+                   **tier, **metrics)
     # Terminal event recorded; NOW publish the state flip (see
     # _decide_shadow) and tear the shadow down outside the lock.
     with self._lock:
@@ -557,6 +679,12 @@ class RolloutController:
     self._state = "serving"
     self._candidate_version = None
     self._candidate_variables = None
+    # The tier policy's executables stay registered (compiled exactly
+    # once, tier-suffixed keys) — dropping the policy object is enough;
+    # a re-offered tier candidate builds a fresh policy whose ledger
+    # rows would expose any recompile.
+    self._candidate_policy = None
+    self._candidate_precision = None
     batcher, self._shadow_batcher = self._shadow_batcher, None
     return batcher
 

@@ -59,6 +59,20 @@ class PolicyReplica:
         max_queue=max_queue, dispatch_margin_ms=dispatch_margin_ms,
         flight_recorder=flight_recorder)
 
+  def use_policy(self, policy: CEMFleetPolicy) -> None:
+    """Hot-swaps this replica's policy (the precision-tier promotion
+    path, serving/rollout.py): an atomic attribute swap under the GIL —
+    in-flight flushes finish on the old policy's executables, the next
+    flush dispatches through the new one. The ladder/bucket_for closure
+    the batcher holds is shared (same ladder sizes by construction), and
+    the device must match the replica's pin — a cross-device swap would
+    silently re-place every request batch."""
+    if policy.device is not self.device:
+      raise ValueError(
+          f"policy pinned to {policy.device} cannot serve replica on "
+          f"{self.device}")
+    self.policy = policy
+
   def _flush(self, items):
     images = [item[0] for item in items]
     seeds = np.asarray([item[1] for item in items], np.uint32)
@@ -73,9 +87,7 @@ class PolicyReplica:
   def warmup(self, make_image) -> None:
     """Compiles the full ladder on this replica's device (server
     startup, before traffic): the measured path then never compiles."""
-    for bucket in self.policy.ladder.sizes:
-      self.policy([make_image(i) for i in range(bucket)],
-                  np.arange(bucket, dtype=np.uint32))
+    self.policy.warm(make_image)
 
 
 class FleetRouter:
@@ -95,6 +107,15 @@ class FleetRouter:
       sheds lowest-priority-first (serving/slo.py). None = unbounded.
     stats: shared ServingStats across ALL replicas (one is created if
       not given) — per-class latency/shed counters aggregate fleet-wide.
+    precision: the fleet's serving Q-scoring tier (cem.
+      SCORING_PRECISIONS; default "f32", the unchanged oracle). Every
+      replica's bucket ladder compiles at this tier; `set_precision`
+      hot-swaps the whole fleet to another tier (the rollout
+      controller's promotion path for a precision candidate), and
+      `make_policy` builds a single-device policy at an arbitrary tier
+      for the shadow/canary phases. Non-f32 executables register
+      tier-suffixed ledger keys, so the shared obs ledger proves
+      exactly-once compilation per bucket per device PER TIER.
     cem / ladder kwargs: forwarded to each replica's CEMFleetPolicy.
   """
 
@@ -108,10 +129,11 @@ class FleetRouter:
                stats: Optional[ServingStats] = None,
                metric_writer=None,
                ledger: Optional[ledger_lib.ExecutableLedger] = None,
-               flight_recorder=None):
+               flight_recorder=None,
+               precision: str = "f32"):
     import jax
 
-    from tensor2robot_tpu.serving.bucketing import BucketLadder
+    from tensor2robot_tpu.research.qtopt import cem
 
     devices = list(jax.devices() if devices is None else devices)
     if not devices:
@@ -120,23 +142,38 @@ class FleetRouter:
     self._metric_writer = metric_writer
     self._metric_step = 0
     self._predictor = predictor
+    self.precision = cem.validate_precision(precision)
     self._seed_lock = threading.Lock()
     self._next_seed = 0
     self._rr = itertools.count()  # least-loaded tie-break rotation
+    # Policy construction parameters, kept so make_policy/set_precision
+    # can rebuild a replica's policy at another tier with IDENTICAL CEM
+    # hyperparameters and seed — the paired shadow comparison is only
+    # sharp because (image, seed) -> action matches across tiers modulo
+    # the numerics under test.
+    self._policy_kwargs = dict(
+        action_size=action_size, num_samples=num_samples,
+        num_elites=num_elites, iterations=iterations, seed=seed)
+    self._ladder_sizes = (tuple(ladder_sizes)
+                          if ladder_sizes is not None else None)
     # Observability spine (ISSUE 11): one ExecutableLedger spanning all
     # replicas (per-device rows via the policies' @device keys) and one
     # flight recorder shared by every replica's batcher (default: the
     # process recorder — ring-only until a dump_dir is configured).
     self.ledger = ledger if ledger is not None else ledger_lib.ExecutableLedger()
     self._recorder = flight_recorder or flight_lib.get_recorder()
+    # One policy per (device, tier) for the fleet's LIFETIME: repeat
+    # make_policy calls (a re-offered precision candidate after a
+    # rollback, a promote following its own shadow phase) reuse the
+    # compiled bucket executables instead of re-registering them — the
+    # per-tier exactly-once ledger claim holds across arbitrarily many
+    # rollout cycles.
+    self._policy_cache = {}
+    self._policy_cache_lock = threading.Lock()
     self.replicas = []
     for device in devices:
-      ladder = (BucketLadder(ladder_sizes) if ladder_sizes is not None
-                else BucketLadder())
-      policy = CEMFleetPolicy(
-          predictor, action_size=action_size, num_samples=num_samples,
-          num_elites=num_elites, iterations=iterations, seed=seed,
-          ladder=ladder, device=device, ledger=self.ledger)
+      policy = self.make_policy(device)
+      ladder = policy.ladder
       replica_max_batch = (ladder.max_batch if max_batch is None
                            else max_batch)
       if replica_max_batch > ladder.max_batch:
@@ -146,6 +183,83 @@ class FleetRouter:
       self.replicas.append(PolicyReplica(
           policy, replica_max_batch, deadline_ms, self.stats, max_queue,
           dispatch_margin_ms, flight_recorder=self._recorder))
+
+  def make_policy(self, device, precision: Optional[str] = None
+                  ) -> CEMFleetPolicy:
+    """A CEMFleetPolicy pinned to `device` at `precision` (default: the
+    fleet's tier), sharing the fleet's predictor, obs ledger, CEM
+    hyperparameters, and seed. The rollout controller builds its
+    shadow-tier policy here so a precision candidate's executables land
+    in the SAME ledger under tier-suffixed keys, and its per-request
+    fold_in stream matches the live tier's exactly. Memoized per
+    (device, tier): a repeat request returns the SAME policy object and
+    its already-compiled buckets."""
+    from tensor2robot_tpu.serving.bucketing import BucketLadder
+
+    if precision is None:
+      precision = self.precision
+    key = (device, precision)
+    with self._policy_cache_lock:
+      policy = self._policy_cache.get(key)
+      if policy is None:
+        ladder = (BucketLadder(self._ladder_sizes)
+                  if self._ladder_sizes is not None else BucketLadder())
+        policy = CEMFleetPolicy(
+            self._predictor, ladder=ladder, device=device,
+            ledger=self.ledger, precision=precision,
+            **self._policy_kwargs)
+        self._policy_cache[key] = policy
+      return policy
+
+  def set_precision(self, precision: str) -> None:
+    """Hot-swaps EVERY replica to the `precision` scoring tier — the
+    fleet-wide promotion of a numerics change (serving/rollout.py's
+    precision-candidate promote). Each replica's tier policy is built
+    AND WARMED (every ladder bucket compiled, on zeros from the
+    predictor's image spec) BEFORE the atomic swap: a promote must not
+    hand live traffic per-bucket compile stalls on the replicas the
+    shadow phase never touched — the zero-recompile serving invariant
+    holds through the cutover, with in-flight flushes finishing on the
+    old tier. Executables land under tier-suffixed ledger keys exactly
+    once each (memoized policies: the shadow device's warmup is a
+    no-op walk over its already-compiled buckets). A same-tier call is
+    a no-op (promoting the tier you already serve must not rebuild the
+    fleet's executable cache)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tensor2robot_tpu.research.qtopt import cem
+
+    cem.validate_precision(precision)
+    if precision == self.precision:
+      return
+    # Warm all replicas CONCURRENTLY: each tier policy compiles under
+    # its own lock for its own device, so the promote stall is ~one
+    # ladder's compile time, not n_devices of them (the shadow
+    # device's policy is already warm — a no-op walk).
+    with ThreadPoolExecutor(max_workers=len(self.replicas)) as pool:
+      swaps = list(zip(self.replicas, pool.map(
+          lambda replica: self.warm_policy(replica.device, precision),
+          self.replicas)))
+    for replica, policy in swaps:
+      replica.use_policy(policy)
+    self.precision = precision
+
+  def warm_policy(self, device, precision: Optional[str] = None
+                  ) -> CEMFleetPolicy:
+    """make_policy + the full-ladder warmup (CEMFleetPolicy.warm on
+    zeros at the predictor's image spec — content is irrelevant, the
+    answers are discarded; only the compiled shapes matter). THE one
+    build-and-warm recipe both cutover paths share: set_precision's
+    per-replica promote and the rollout controller's tier-candidate
+    offer — so a shadow tier can never warm differently from the tier
+    the promote later installs."""
+    import numpy as np
+
+    policy = self.make_policy(device, precision)
+    spec = self._predictor.get_feature_specification()["image"]
+    zero = np.zeros(tuple(spec.shape), spec.dtype)
+    policy.warm(lambda i: zero)
+    return policy
 
   # -- lifecycle -----------------------------------------------------------
 
@@ -234,7 +348,10 @@ class FleetRouter:
   def compile_ledger(self) -> dict:
     """{device_label: {bucket: compile_count}} over every replica — the
     fleet invariant is every inner value == 1 (one executable per
-    bucket PER DEVICE, recompiled never)."""
+    bucket PER DEVICE, recompiled never). Reads the CURRENT serving
+    tier's policies; across a set_precision swap the shared obs
+    `ledger` is the cross-tier record (tier-suffixed keys, one row per
+    bucket per device per tier, each compiled exactly once)."""
     return {
         str(replica.device): dict(replica.policy.compile_counts)
         for replica in self.replicas}
@@ -243,6 +360,7 @@ class FleetRouter:
     """Aggregated stats + the per-device executable ledger + depths."""
     out = self.stats.snapshot()
     out["replicas"] = len(self.replicas)
+    out["precision"] = self.precision
     out["compile_ledger"] = self.compile_ledger()
     out["replica_pending"] = [replica.batcher.pending()
                               for replica in self.replicas]

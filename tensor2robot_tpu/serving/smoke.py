@@ -69,13 +69,24 @@ class TinyQPredictor(AbstractPredictor):
       w = w + jitter * rng.standard_normal(w.shape).astype(np.float32)
     return {"params": {"w": jnp.asarray(scale * w)}}
 
-  def set_variables(self, variables, version=None) -> None:
-    """See AbstractPredictor.set_variables (promotion hot-swap)."""
-    if np.shape(variables["params"]["w"]) != np.shape(
-        self._variables["params"]["w"]):
+  def set_variables(self, variables, version=None,
+                    cast: bool = False) -> None:
+    """See AbstractPredictor.set_variables (promotion hot-swap, incl.
+    the cast= precision-cast seam: drifted dtypes reject unless the
+    cast is declared intentional, then install at the live aval)."""
+    w = variables["params"]["w"]
+    live = self._variables["params"]["w"]
+    if np.shape(w) != np.shape(live):
       raise ValueError("hot-swap shape mismatch")
-    self._variables = {
-        "params": {"w": jnp.asarray(variables["params"]["w"])}}
+    w = jnp.asarray(w)
+    if w.dtype != live.dtype:
+      if not cast:
+        raise ValueError(
+            f"hot-swap dtype mismatch: {live.dtype} -> {w.dtype} "
+            "(pass cast=True for an intentional precision cast onto "
+            "the served dtype).")
+      w = w.astype(live.dtype)
+    self._variables = {"params": {"w": w}}
     self._version = self._next_swap_version(version)
 
   def make_image(self, seed: int) -> np.ndarray:
