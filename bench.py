@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 14
+ROUND = 15
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -1046,6 +1046,31 @@ def _bench_precision_compact():
       rollout_cycle_s=60.0, enforce_bars=False)
 
 
+def _bench_faults_compact():
+  """Fault-tolerance block for the bench detail (ISSUE 14).
+
+  The committed chipless artifact (FAULTS_r15.json) carries the full
+  chaos protocol — scripted replica faults under paced traffic with
+  the quarantine→probe→reinstate arc, degraded-mode shedding,
+  dispatcher restart budgets, export-corruption rejection, and the
+  learner's bit-exact crash-resume — where recovery LATENCY numbers
+  carry the virtual-mesh caveat. This block is the driver-refreshable
+  real-chip counterpart: a reduced run of the same phases on the
+  window's devices, where post-quarantine p99 re-convergence becomes
+  a measured chip number. The live kill-resume run is skipped here
+  (minutes of loop time; the committed artifact carries it) — the
+  deterministic bit-parity resume and every router/dispatcher/export
+  phase run in full.
+  """
+  from tensor2robot_tpu.serving.fault_bench import (R15_CLASSES,
+                                                    measure_faults)
+  return measure_faults(
+      classes=tuple((slo_class, max(2, clients // 2), hz)
+                    for slo_class, clients, hz in R15_CLASSES),
+      chaos_s=3.0, recovery_s=2.0, parity_steps=(15, 15),
+      live_resume=False, enforce_bars=False)
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1212,6 +1237,11 @@ def main() -> None:
   except Exception as e:
     precision = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    faults = _bench_faults_compact()
+  except Exception as e:
+    faults = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1273,6 +1303,7 @@ def main() -> None:
       "anakin_multichip": anakin_multichip,
       "obs": obs,
       "precision": precision,
+      "faults": faults,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1333,6 +1364,13 @@ def main() -> None:
       "cem_bf16_action_agreement": precision.get(
           "cem_bf16_action_agreement"),
       "cem_bf16_speedup": precision.get("cem_bf16_speedup"),
+      # Fault-tolerance sentinels (ISSUE 14): did the post-quarantine
+      # clean window put every class's p99 back inside its budget, and
+      # did the deterministic crash-resume reproduce the uninterrupted
+      # run bit for bit. Null-safe under outage/error like every
+      # compact key.
+      "fault_recovery_p99_ok": faults.get("fault_recovery_p99_ok"),
+      "learner_resume_parity": faults.get("learner_resume_parity"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,
   }))

@@ -245,6 +245,24 @@ else
     python -m tensor2robot_tpu.replay.precision_bench --smoke \
       --out "$STAGE_TMP"'
 fi
+# Seventh chipless backstop (ISSUE 14): the chaos protocol — scripted
+# deterministic faults under paced traffic (quarantine/probe/reinstate,
+# degraded shedding, dispatcher restarts, export-corruption rejection,
+# learner crash-resume with the bit-parity bar). Same tmp→mv atomicity
+# and pytest deferral rules (its p99-recovery bars are timing
+# measurements).
+if [ -s "FAULTS_${RTAG}.json" ]; then
+  log "skip FAULTS_${RTAG}.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring faults backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "FAULTS_${RTAG}.json" 3000 sh -c '
+    python -m tensor2robot_tpu.serving.fault_bench --smoke \
+      --out "$STAGE_TMP"'
+fi
 while [ "$(date +%s)" -lt "$deadline" ]; do
   # Never perturb a live test run: the probe's jax import is real CPU
   # on a small host, and the serving smoke's amortization bar is a

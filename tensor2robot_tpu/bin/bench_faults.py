@@ -1,0 +1,22 @@
+"""Chaos benchmark CLI: the bin/ face of serving/fault_bench.
+
+    # The committed FAULTS_r15 protocol (chipless: the CLI bootstraps an
+    # 8-virtual-device CPU mesh and re-execs itself; acceptance bars
+    # are ENFORCED at generation time):
+    python -m tensor2robot_tpu.bin.bench_faults --smoke --out FAULTS_r15.json
+
+    # Reduced tier-1 lane (2 devices, short windows, same structure):
+    python -m tensor2robot_tpu.bin.bench_faults --ci
+
+Everything — the scripted fault schedule under paced traffic, the
+quarantine→probe→reinstate arc, degraded-mode shedding, dispatcher
+restart budgets, export-corruption rejection, and the learner's
+bit-exact crash-resume — lives in serving/fault_bench.py; this wrapper
+exists so the chaos protocol is discoverable next to bench_fleet in
+the bin/ surface every other measured artifact is produced from.
+"""
+
+from tensor2robot_tpu.serving.fault_bench import main
+
+if __name__ == "__main__":
+  main()

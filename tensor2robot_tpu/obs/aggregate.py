@@ -226,18 +226,21 @@ def _slo_rollup(registries: dict) -> dict:
       continue
     class_name, _, field = name[len(prefix):].partition("/")
     entry = classes.setdefault(class_name, {
-        "requests": 0, "shed_expired": 0, "shed_capacity": 0})
+        "requests": 0, "shed_expired": 0, "shed_capacity": 0,
+        "shed_fault": 0})
     if field in entry:
       entry[field] += int(value)
   for class_name, entry in classes.items():
-    entry["shed"] = entry["shed_expired"] + entry["shed_capacity"]
+    entry["shed"] = (entry["shed_expired"] + entry["shed_capacity"]
+                     + entry["shed_fault"])
     latency = histograms.get(f"{prefix}{class_name}/latency_ms")
     if latency and latency.get("merged_samples"):
       entry["latency_p50_ms"] = latency["p50"]
       entry["latency_p99_ms"] = latency["p99"]
   shed_total = sum(entry["shed"] for entry in classes.values())
   global_shed = (counters.get("serving/shed_expired", 0)
-                 + counters.get("serving/shed_capacity", 0))
+                 + counters.get("serving/shed_capacity", 0)
+                 + counters.get("serving/shed_fault", 0))
   # Consistency across SOURCES too: the global counters from every
   # registry snapshot must sum to the per-class sums — a process whose
   # sheds bypassed class accounting (or a double-merged snapshot)
@@ -247,11 +250,13 @@ def _slo_rollup(registries: dict) -> dict:
   for source in registries["per_source"]:
     source_counters = source["counters"]
     source_global = (source_counters.get("serving/shed_expired", 0)
-                     + source_counters.get("serving/shed_capacity", 0))
+                     + source_counters.get("serving/shed_capacity", 0)
+                     + source_counters.get("serving/shed_fault", 0))
     source_classes = sum(
         int(value) for name, value in source_counters.items()
         if name.startswith(prefix)
-        and name.rsplit("/", 1)[-1] in ("shed_expired", "shed_capacity"))
+        and name.rsplit("/", 1)[-1] in ("shed_expired", "shed_capacity",
+                                        "shed_fault"))
     if source_global != source_classes:
       per_source_ok = False
   return {

@@ -1,0 +1,299 @@
+"""Deterministic fault injection: every failure mode as a test input.
+
+The fleet's observability spine (PR 8/9) can SEE failures; proving the
+fleet *recovers* from them needs failures on demand — reproducibly, so
+a chaos artifact's bars are re-runnable, and through explicit seams, so
+the injection points are the same code paths real faults travel (no
+monkeypatching: a patched method proves nothing about the unpatched
+fleet).
+
+A ``FaultPlan`` is a seeded schedule of ``FaultSpec`` entries. Each
+spec names:
+
+- a **kind** (what goes wrong): ``dispatch_error`` (a replica's device
+  call raises), ``latency_spike`` (a dispatch stalls for latency_s),
+  ``hung_flush`` (a batcher flush wedges), ``thread_kill`` (a
+  dispatcher thread dies mid-flush — raised as a ``BaseException`` so
+  it models the deaths ordinary ``except Exception`` recovery cannot
+  catch), ``export_corrupt`` / ``export_partial_write`` (an export
+  artifact lands damaged on disk), ``crash`` (the learner process dies
+  at an optimizer step);
+- a **point** (which seam checks it): components with a plan installed
+  call ``plan.perturb(point, site=...)`` at exactly one place each —
+  ``PolicyReplica`` at ``replica_dispatch``, ``MicroBatcher`` at
+  ``batcher_flush``, ``ExportWatcher`` at ``export_load`` (via
+  ``check`` + ``damage_export``), ``ReplayTrainLoop`` at
+  ``learner_step``;
+- a **schedule**: ``at=N`` fires on the N-th check of that
+  (point, site) — or, when the seam passes an explicit ``index``
+  (the learner's optimizer step), on index == N — with ``every``/
+  ``count`` for repetition, or ``probability`` for a seeded Bernoulli
+  per check. Same plan + same call sequence ⇒ the same faults fire at
+  the same places, every run.
+
+Every fired fault is recorded on the plan (``plan.fired``) AND triggers
+a flight-recorder dump (reason ``fault_injected``) stamped with the
+ACTIVE correlation id (obs/context.py) — so a chaos run's post-mortems
+name the exact request each injected fault hit, exactly like a real
+incident's would.
+
+The no-plan case is the oracle: every seam's check is
+``if plan is None: return`` — components without a plan installed
+execute the identical instruction stream they did before this module
+existed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.obs import context as context_lib
+from tensor2robot_tpu.obs import flight_recorder as flight_lib
+
+# The closed set of failure modes a plan can schedule. Adding one means
+# adding the seam that honors it — an unknown kind is a typo, not a
+# silently inert spec.
+FAULT_KINDS = (
+    "dispatch_error",         # replica device call raises InjectedFault
+    "latency_spike",          # dispatch sleeps latency_s, then proceeds
+    "hung_flush",             # batcher flush wedges latency_s
+    "thread_kill",            # dispatcher thread dies (InjectedKill)
+    "export_corrupt",         # export npz overwritten with garbage
+    "export_partial_write",   # export npz truncated mid-file
+    "crash",                  # learner raises InjectedCrash at a step
+)
+
+
+class InjectedFault(RuntimeError):
+  """A scheduled, retryable fault (a replica dispatch error): ordinary
+  ``Exception`` machinery — retries, circuit breakers — must absorb it
+  exactly as it would a real device error."""
+
+  def __init__(self, kind: str, point: str, site: str):
+    self.kind = kind
+    self.point = point
+    self.site = site
+    super().__init__(f"injected {kind} at {point}[{site}]")
+
+
+class InjectedKill(BaseException):
+  """A scheduled thread death. Deliberately NOT an ``Exception``: the
+  dispatcher's per-flush ``except Exception`` recovery must not absorb
+  it — it models the class of deaths (KeyboardInterrupt on the wrong
+  thread, MemoryError, a C-extension abort) only the thread-level
+  death handler can account for."""
+
+  def __init__(self, point: str, site: str):
+    self.point = point
+    self.site = site
+    super().__init__(f"injected thread kill at {point}[{site}]")
+
+
+class InjectedCrash(RuntimeError):
+  """A scheduled learner crash at a named optimizer step — the
+  preemption/OOM stand-in the checkpoint-resume path recovers from."""
+
+  def __init__(self, step: int):
+    self.step = step
+    super().__init__(f"injected learner crash at optimizer step {step}")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+  """One scheduled fault (see module docstring for the field grammar).
+
+  Attributes:
+    kind: one of FAULT_KINDS.
+    point: the seam that checks this spec ("replica_dispatch",
+      "batcher_flush", "export_load", "learner_step").
+    site: exact site match within the point ("" matches every site) —
+      a device string for replicas, a batcher name, an export version.
+    at: fire when the (point, site) check counter — or the seam's
+      explicit ``index``, when it passes one — equals this value.
+      None with probability=0 never fires (a disabled spec).
+    every: after ``at``, also fire every `every` further checks
+      (0 = fire at `at` only).
+    count: total fire budget for this spec.
+    probability: seeded Bernoulli per check (alternative to `at`;
+      deterministic given the plan seed and the call sequence).
+    latency_s: stall duration for latency_spike / hung_flush.
+  """
+
+  kind: str
+  point: str
+  site: str = ""
+  at: Optional[int] = None
+  every: int = 0
+  count: int = 1
+  probability: float = 0.0
+  latency_s: float = 0.0
+
+  def __post_init__(self):
+    if self.kind not in FAULT_KINDS:
+      raise ValueError(
+          f"unknown fault kind {self.kind!r}; known: {FAULT_KINDS}")
+    if self.at is None and self.probability <= 0.0:
+      raise ValueError(
+          f"spec {self.kind}@{self.point} has no schedule: set `at` "
+          "or a positive `probability`")
+    if self.probability < 0.0 or self.probability > 1.0:
+      raise ValueError(f"probability must be in [0, 1], got "
+                       f"{self.probability}")
+    if self.count < 1:
+      raise ValueError(f"count must be >= 1, got {self.count}")
+
+
+class FaultPlan:
+  """A seeded, deterministic schedule of faults over explicit seams.
+
+  Thread-safe: seams fire from dispatcher threads, collector threads,
+  and the learner concurrently; counters and fire budgets are guarded
+  by one lock (held only for the bookkeeping — sleeps and raises
+  happen outside it).
+  """
+
+  def __init__(self, specs: Sequence[FaultSpec], seed: int = 0,
+               recorder: Optional[flight_lib.FlightRecorder] = None):
+    self.specs = tuple(specs)
+    self.seed = seed
+    self._recorder = recorder
+    self._lock = threading.Lock()
+    self._counters: Dict[Tuple[str, str], int] = {}
+    # Per-spec state: independent seeded rng (probability draws stay
+    # deterministic per spec regardless of the other specs' traffic)
+    # and the remaining fire budget.
+    self._rngs = [np.random.default_rng(seed * 1_000_003 + i)
+                  for i in range(len(self.specs))]
+    self._remaining = [spec.count for spec in self.specs]
+    self.fired: List[dict] = []
+
+  def _matches(self, spec: FaultSpec, spec_index: int, point: str,
+               site: str, tick: int) -> bool:
+    """Caller holds the lock. `tick` is the schedule position: the
+    seam's explicit index when given, else the (point, site) counter."""
+    if spec.point != point:
+      return False
+    if spec.site and spec.site != site:
+      return False
+    if self._remaining[spec_index] <= 0:
+      return False
+    if spec.at is not None:
+      if tick < spec.at:
+        return False
+      offset = tick - spec.at
+      return offset == 0 or (spec.every > 0 and offset % spec.every == 0)
+    return float(self._rngs[spec_index].random()) < spec.probability
+
+  def check(self, point: str, site: str = "",
+            index: Optional[int] = None) -> List[FaultSpec]:
+    """Advances the (point, site) schedule one tick; returns the specs
+    that fire on it (fire records + flightrec dumps included). Seams
+    with bespoke fault actions (the export watcher) call this and act
+    on the result; everything else uses ``perturb``."""
+    fired: List[FaultSpec] = []
+    with self._lock:
+      key = (point, site)
+      counter = self._counters.get(key, 0)
+      self._counters[key] = counter + 1
+      tick = counter if index is None else int(index)
+      for i, spec in enumerate(self.specs):
+        if self._matches(spec, i, point, site, tick):
+          self._remaining[i] -= 1
+          fired.append(spec)
+    for spec in fired:
+      self._record_fire(spec, point, site, tick)
+    return fired
+
+  def _record_fire(self, spec: FaultSpec, point: str, site: str,
+                   tick: int) -> None:
+    # The active correlation id rides the dump (ISSUE 14 contract):
+    # a fault fired inside a replica flush carries the batch's
+    # request_ids; one fired at the router front door carries the
+    # single request_id; the learner's carries neither (step-scoped).
+    attrs = context_lib.context_attrs()
+    record = {
+        "kind": spec.kind, "point": point, "site": site, "tick": tick,
+        "wall_time": time.time(),
+    }
+    record.update({k: attrs[k] for k in ("request_id", "request_ids")
+                   if k in attrs})
+    with self._lock:
+      self.fired.append(record)
+    recorder = self._recorder or flight_lib.get_recorder()
+    try:
+      recorder.trigger("fault_injected", fault=spec.kind, point=point,
+                       site=site, tick=tick,
+                       **{k: v for k, v in record.items()
+                          if k in ("request_id", "request_ids")})
+    except Exception:
+      pass  # diagnostics never break the injection (listener contract)
+
+  def perturb(self, point: str, site: str = "",
+              index: Optional[int] = None) -> None:
+    """The one-line seam: check the schedule and ACT on what fires —
+    sleep for latency faults, raise for error/kill/crash faults. When
+    several specs fire on one tick, stalls apply first (a fault that
+    both delays and then fails models a timing-out dispatch)."""
+    fired = self.check(point, site, index=index)
+    if not fired:
+      return
+    for spec in fired:
+      if spec.kind in ("latency_spike", "hung_flush") and spec.latency_s:
+        time.sleep(spec.latency_s)
+    for spec in fired:
+      if spec.kind == "dispatch_error":
+        raise InjectedFault(spec.kind, point, site)
+      if spec.kind == "thread_kill":
+        raise InjectedKill(point, site)
+      if spec.kind == "crash":
+        raise InjectedCrash(index if index is not None else -1)
+
+  def fired_counts(self) -> Dict[str, int]:
+    """{kind: times fired} — the chaos artifact's injection ledger."""
+    with self._lock:
+      counts: Dict[str, int] = {}
+      for record in self.fired:
+        counts[record["kind"]] = counts.get(record["kind"], 0) + 1
+      return counts
+
+  def snapshot(self) -> dict:
+    with self._lock:
+      return {
+          "seed": self.seed,
+          "specs": [dataclasses.asdict(spec) for spec in self.specs],
+          "fired": [dict(record) for record in self.fired],
+      }
+
+
+def damage_export(export_dir: str, kind: str,
+                  filename: Optional[str] = None) -> str:
+  """Applies an export_corrupt / export_partial_write fault to a
+  published export dir, deterministically: corrupt = the variables npz
+  overwritten with non-npz bytes (a bitrotted artifact), partial_write
+  = truncated to half length (a writer killed mid-copy — the failure
+  async export's tmp→mv normally prevents, modeled here for consumers
+  that must still survive a broken publisher). Returns the damaged
+  path. The watcher-side validation (serving/rollout.ExportWatcher)
+  must reject either damage with a flight-recorder record and never
+  swap it in."""
+  if filename is None:
+    from tensor2robot_tpu.export.native_export_generator import (
+        VARIABLES_NPZ)
+    filename = VARIABLES_NPZ
+  path = os.path.join(export_dir, filename)
+  if kind == "export_corrupt":
+    with open(path, "wb") as f:
+      f.write(b"not-an-npz\x00" * 16)
+  elif kind == "export_partial_write":
+    size = os.path.getsize(path)
+    with open(path, "rb+") as f:
+      f.truncate(max(1, size // 2))
+  else:
+    raise ValueError(f"damage_export got non-export kind {kind!r}")
+  return path

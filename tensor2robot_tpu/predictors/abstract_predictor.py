@@ -8,7 +8,6 @@ get_feature_specification/close, with restore-with-timeout semantics.
 from __future__ import annotations
 
 import abc
-import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -20,12 +19,20 @@ class AbstractPredictor(abc.ABC):
   """Loads a trained artifact and serves predict() on the robot."""
 
   @abc.abstractmethod
-  def restore(self, timeout_s: float = 0.0) -> bool:
+  def restore(self, timeout_s: float = 0.0,
+              raise_on_timeout: bool = False) -> bool:
     """Loads (or hot-reloads) the newest available model.
 
     Blocks up to timeout_s waiting for a first model to appear (robots
     start before the trainer's first export — SURVEY.md §2 predictors
-    row). Returns True when a model is loaded.
+    row), polling with jittered exponential backoff
+    (utils/backoff.py: a robot fleet restarting together must not
+    hammer the export filesystem in lockstep). Returns True when a
+    model is loaded. With ``raise_on_timeout``, a timeout that leaves
+    NO model loaded raises ``utils.backoff.PollTimeout`` naming the
+    path that was being waited on instead of returning False — the
+    loud form for deployments where silently proceeding without a
+    model is worse than crashing with the path in the message.
     """
 
   @abc.abstractmethod
@@ -165,14 +172,35 @@ class AbstractPredictor(abc.ABC):
         return candidate
       return None
 
-    return self._wait_for(newest, timeout_s)
+    return self._wait_for(newest, timeout_s,
+                          description=f"an export under {export_root}")
 
   @staticmethod
-  def _wait_for(predicate, timeout_s: float, poll_s: float = 0.5):
-    """Polls predicate() until truthy or timeout; returns its value."""
-    deadline = time.monotonic() + timeout_s
-    while True:
-      value = predicate()
-      if value or time.monotonic() >= deadline:
-        return value
-      time.sleep(min(poll_s, max(0.0, deadline - time.monotonic())))
+  def _wait_for(predicate, timeout_s: float,
+                description: Optional[str] = None):
+    """Polls predicate() until truthy or timeout; returns its value.
+
+    Jittered exponential backoff (utils/backoff.py) instead of the old
+    fixed 0.5s cadence: a restarting robot fleet decorrelates instead
+    of stampeding the export filesystem, and a long wait backs off to
+    ~2s polls. `description` names the awaited path for the loud
+    restore(raise_on_timeout=True) form.
+    """
+    from tensor2robot_tpu.utils import backoff
+    return backoff.poll_with_backoff(
+        predicate, timeout_s, initial_s=0.1, max_s=2.0,
+        description=description)
+
+  def _timeout_unloaded(self, description: str, timeout_s: float,
+                        raise_on_timeout: bool) -> bool:
+    """Shared restore() timeout exit: False when a model is already
+    serving (a hot-reload poll that found nothing new is healthy), a
+    PollTimeout naming `description` when raise_on_timeout and NOTHING
+    was ever loaded (the robot would otherwise start serving thin
+    air)."""
+    if self.model_version >= 0:
+      return True
+    if raise_on_timeout:
+      from tensor2robot_tpu.utils import backoff
+      raise backoff.PollTimeout(description, timeout_s, 0)
+    return False

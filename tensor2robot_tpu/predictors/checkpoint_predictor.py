@@ -46,7 +46,8 @@ class CheckpointPredictor(AbstractPredictor):
 
     return jax.jit(predict)
 
-  def restore(self, timeout_s: float = 0.0) -> bool:
+  def restore(self, timeout_s: float = 0.0,
+              raise_on_timeout: bool = False) -> bool:
     if self._checkpoint_dir is None:
       raise ValueError("No checkpoint_dir given; use init_randomly().")
     import os
@@ -67,9 +68,12 @@ class CheckpointPredictor(AbstractPredictor):
       return step, self._manager.restore(
           step, args=ocp.args.StandardRestore())
 
-    result = self._wait_for(_latest, timeout_s)
+    result = self._wait_for(
+        _latest, timeout_s,
+        description=f"a checkpoint under {directory}")
     if not result:
-      return self._version >= 0
+      return self._timeout_unloaded(
+          f"a checkpoint under {directory}", timeout_s, raise_on_timeout)
     step, restored = result
     ema = restored.get("ema_params")
     params = ema if ema is not None else restored["params"]

@@ -41,10 +41,13 @@ class ExportedModelPredictor(AbstractPredictor):
 
   # --- loading -------------------------------------------------------------
 
-  def restore(self, timeout_s: float = 0.0) -> bool:
+  def restore(self, timeout_s: float = 0.0,
+              raise_on_timeout: bool = False) -> bool:
     newest = self._poll_newer_version(self._export_root, timeout_s)
     if newest is None:
-      return self._version >= 0
+      return self._timeout_unloaded(
+          f"a native export under {self._export_root}", timeout_s,
+          raise_on_timeout)
     export_dir = os.path.join(self._export_root, str(newest))
     with open(os.path.join(export_dir, SERVING_FN_NAME), "rb") as f:
       exported = jax.export.deserialize(bytearray(f.read()))

@@ -29,11 +29,14 @@ class ExportedSavedModelPredictor(AbstractPredictor):
     self._loaded = None
     self._feature_spec: Optional[ts.TensorSpecStruct] = None
 
-  def restore(self, timeout_s: float = 0.0) -> bool:
+  def restore(self, timeout_s: float = 0.0,
+              raise_on_timeout: bool = False) -> bool:
     import tensorflow as tf
     newest = self._poll_newer_version(self._export_root, timeout_s)
     if newest is None:
-      return self._version >= 0
+      return self._timeout_unloaded(
+          f"a SavedModel export under {self._export_root}", timeout_s,
+          raise_on_timeout)
     export_dir = os.path.join(self._export_root, str(newest))
     loaded = tf.saved_model.load(export_dir)
     self._loaded = loaded  # keep a reference: signatures hold weak refs

@@ -195,6 +195,27 @@ class TargetNetwork:
   def refresh_count(self) -> int:
     return self._refresh_count
 
+  # -- checkpoint state (ISSUE 14: learner crash-resume) -------------------
+
+  def target_state(self):
+    """(host target variables tree, bookkeeping meta) for a loop
+    checkpoint — the target net is NOT derivable from TrainState (it
+    lags by up to refresh_every steps), so resume must carry it or the
+    first post-resume labels bootstrap off the wrong Q."""
+    variables = (None if self._target_variables is None else
+                 jax.tree_util.tree_map(np.asarray,
+                                        self._target_variables))
+    return variables, {"refresh_count": self._refresh_count,
+                       "last_refresh_step": self.last_refresh_step}
+
+  def restore_target_state(self, variables, meta) -> None:
+    """Inverse of target_state (placement rule re-applied)."""
+    self._target_variables = (
+        None if variables is None else
+        self._place(jax.tree_util.tree_map(jnp.asarray, variables)))
+    self._refresh_count = int(meta["refresh_count"])
+    self.last_refresh_step = int(meta["last_refresh_step"])
+
 
 class BellmanUpdater(TargetNetwork):
   """Q-target labeller over a critic model with a ``q_predicted`` head."""
@@ -336,6 +357,16 @@ class BellmanUpdater(TargetNetwork):
       self._ledger.record_dispatch("bellman_targets",
                                    time.perf_counter() - start)
     return targets, q_next
+
+  @property
+  def next_label_seed(self) -> int:
+    """The label-seed counter (checkpointed so a resumed loop's CEM
+    label draws CONTINUE the interrupted stream instead of replaying
+    seed 0 — part of the resume-equals-uninterrupted parity bar)."""
+    return self._next_label_seed
+
+  def restore_label_seed(self, next_label_seed: int) -> None:
+    self._next_label_seed = int(next_label_seed)
 
   def td_errors(self, variables, batch,
                 targets: np.ndarray) -> np.ndarray:

@@ -225,6 +225,19 @@ class TransitionQueue:
     return {key: np.concatenate([chunk[key] for chunk in chunks])
             for key in chunks[0]}
 
+  def restore_counters(self, enqueued: int, dropped: int,
+                       dequeued: int) -> None:
+    """Re-seats the monotonic accounting after a crash-resume
+    (ISSUE 14). Contents are deliberately NOT restored: transitions in
+    flight at the crash are lost by design (drop-oldest semantics — a
+    fresher policy has outgrown them anyway), but the ingest ledger
+    must stay monotonic across the restart or the drop_rate health
+    metric silently resets."""
+    with self._lock:
+      self.enqueued = int(enqueued)
+      self.dropped = int(dropped)
+      self.dequeued = int(dequeued)
+
   def __len__(self) -> int:
     with self._lock:
       return self._rows

@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 import optax
 
+from tensor2robot_tpu.obs import faults as faults_lib
 from tensor2robot_tpu.obs import flight_recorder as flight_lib
 from tensor2robot_tpu.obs import ledger as obs_ledger
 from tensor2robot_tpu.obs import registry as registry_lib
@@ -98,7 +99,8 @@ class _HotReloadPredictor(AbstractPredictor):
     self._variables = variables
     self._version += 1
 
-  def restore(self, timeout_s: float = 0.0) -> bool:
+  def restore(self, timeout_s: float = 0.0,
+              raise_on_timeout: bool = False) -> bool:
     return True
 
   def init_randomly(self) -> None:
@@ -350,6 +352,23 @@ class ReplayLoopConfig:
   # path (BellmanUpdater.td_errors — f32-updates territory), so the
   # TD-reduction bar compares tiers against ONE oracle metric.
   precision: str = "f32"
+  # Learner crash-resume (ISSUE 14): checkpoint_every > 0 writes a
+  # loop checkpoint every that-many OPTIMIZER steps — TrainState via
+  # orbax (train/checkpoints.CheckpointManager, synchronous so the
+  # sidecar can finalize after it) plus a tmp→mv sidecar carrying the
+  # lagged target net, the full replay-ring state (storage, cursors,
+  # priorities, sampling rng), label-seed counter, ingest accounting,
+  # and the eval history — into <logdir>/checkpoints. resume=True
+  # restores the NEWEST VALID checkpoint (corrupt/partial dirs are
+  # rejected with a flightrec record and older steps tried) and
+  # continues from its exact step; with nothing valid on disk it
+  # starts fresh (the preemption-tolerant default: "resume if you
+  # can"). Host path only for now: the fused device paths' state
+  # lives inside donated device buffers, and checkpointing them is
+  # the multi-controller work ROADMAP item 1 scopes.
+  checkpoint_every: int = 0
+  checkpoint_keep: int = 3
+  resume: bool = False
   # Windowed device-trace capture (ISSUE 11 satellite): (start, end)
   # OPTIMIZER steps handed to utils.profiling.ProfilerHook — the same
   # windowed jax.profiler capture train_eval runs, now available on
@@ -376,7 +395,8 @@ class ReplayTrainLoop:
 
   def __init__(self, config: ReplayLoopConfig, logdir: str, model=None,
                flight_recorder: Optional[flight_lib.FlightRecorder] = None,
-               watchdog: Optional[watchdog_lib.Watchdog] = None):
+               watchdog: Optional[watchdog_lib.Watchdog] = None,
+               fault_plan: Optional[faults_lib.FaultPlan] = None):
     from tensor2robot_tpu.train.trainer import Trainer
     from tensor2robot_tpu.utils.metric_writer import MetricWriter
 
@@ -385,6 +405,17 @@ class ReplayTrainLoop:
     self.config = config
     cem_lib.validate_precision(config.precision)  # fail at construction
     self.logdir = logdir
+    # Fault seam (ISSUE 14): the ONE point a scheduled learner `crash`
+    # enters this loop — checked per optimizer step on the host path.
+    self._faults = fault_plan
+    if (config.checkpoint_every or config.resume) and (
+        config.device_resident or config.anakin):
+      raise ValueError(
+          "checkpoint_every/resume cover the host path: the fused "
+          "device paths' replay/env state lives inside donated device "
+          "buffers (checkpointing them is the multi-controller work "
+          "ROADMAP item 1 scopes). Run without device_resident/anakin "
+          "to use crash-resume.")
     self.model = model if model is not None else self._default_model()
     # Observability spine (ISSUE 11): one ExecutableLedger per loop run
     # (every compiled program this loop owns registers + records
@@ -461,6 +492,15 @@ class ReplayTrainLoop:
     self.feeder = ReplayFeeder(self.queue, self.buffer, config.min_fill)
     self.compile_counts: Dict[str, int] = {}
     self._collectors: List[CollectorWorker] = []
+    self._ckpt_manager = None
+    if config.checkpoint_every or config.resume:
+      from tensor2robot_tpu.train.checkpoints import CheckpointManager
+      self.checkpoint_root = os.path.join(logdir, "checkpoints")
+      # Synchronous saves: the sidecar finalizes AFTER the orbax step
+      # does, so sidecar-present implies whole-checkpoint-usable.
+      self._ckpt_manager = CheckpointManager(
+          self.checkpoint_root, max_to_keep=config.checkpoint_keep,
+          save_interval_steps=0, async_checkpointing=False)
 
   # --- helpers -------------------------------------------------------------
 
@@ -685,6 +725,83 @@ class ReplayTrainLoop:
         **extra,
     }
 
+  # --- crash-resume checkpoints (ISSUE 14) ----------------------------------
+
+  def _checkpoint_fingerprint(self) -> Dict:
+    """The shape-critical config slice a resume must match exactly —
+    a drifted batch/capacity would silently change every compiled
+    shape, so it refuses instead."""
+    c = self.config
+    return {"image_size": c.image_size, "action_size": c.action_size,
+            "batch_size": c.batch_size, "capacity": c.capacity,
+            "num_buffer_shards": c.num_buffer_shards,
+            "prioritized": c.prioritized, "gamma": c.gamma,
+            "seed": c.seed, "precision": c.precision}
+
+  def _save_checkpoint(self, step: int, state, updater,
+                       initial_eval: Dict, eval_history: List) -> None:
+    """One atomic loop checkpoint: orbax TrainState first
+    (synchronous), then the tmp→mv sidecar — target net, full ring
+    state, label-seed counter, ingest accounting, eval history — so a
+    crash between the two leaves an orphaned orbax step the resume
+    validation rejects, never a half-checkpoint."""
+    from tensor2robot_tpu.train import checkpoints as checkpoints_lib
+    with trace_lib.span("replay/checkpoint", step=step):
+      self._ckpt_manager.save(step, state, force=True)
+      self._ckpt_manager.wait()
+      target_vars, target_meta = updater.target_state()
+      buffer_arrays, buffer_meta = self.buffer.state_dict()
+      trees = {} if target_vars is None else {"target": target_vars}
+      meta = {
+          "fingerprint": self._checkpoint_fingerprint(),
+          "target": target_meta,
+          "next_label_seed": updater.next_label_seed,
+          "buffer_meta": buffer_meta,
+          "queue_counters": {
+              key: value for key, value in self.queue.stats().items()
+              if key != "pending"},
+          "initial_eval": initial_eval,
+          "eval_history": eval_history,
+      }
+      checkpoints_lib.save_sidecar(
+          self.checkpoint_root, step, trees=trees,
+          flats={"buffer": buffer_arrays}, meta=meta)
+      checkpoints_lib.prune_sidecars(self.checkpoint_root,
+                                     self._ckpt_manager.all_steps())
+    self.recorder.record("event", "loop_checkpoint", step=step)
+
+  def _restore_checkpoint(self, state):
+    """Restores the newest VALID checkpoint into (state, sidecar);
+    returns (state, trees, meta) or None when nothing valid exists
+    (then the loop starts fresh — preemption-tolerant default).
+    Rejected newer steps leave ``checkpoint_rejected`` flightrec
+    records via latest_resumable_step."""
+    from tensor2robot_tpu.train import checkpoints as checkpoints_lib
+    step = checkpoints_lib.latest_resumable_step(
+        self.checkpoint_root, recorder=self.recorder)
+    if step is None:
+      return None
+    state = self._ckpt_manager.restore(state, step=step)
+    trees, flats, meta = checkpoints_lib.load_sidecar(
+        self.checkpoint_root, step)
+    fingerprint = self._checkpoint_fingerprint()
+    if meta.get("fingerprint") != fingerprint:
+      raise ValueError(
+          "resume fingerprint mismatch: checkpoint was written by "
+          f"{meta.get('fingerprint')}, this loop is {fingerprint} — "
+          "resume needs an identically configured loop (shapes would "
+          "drift otherwise)")
+    if int(np.asarray(state.step)) != int(step):
+      raise ValueError(
+          f"restored TrainState.step {int(np.asarray(state.step))} != "
+          f"checkpoint step {step}")
+    self.buffer.load_state_dict(flats["buffer"], meta["buffer_meta"])
+    counters = meta.get("queue_counters", {})
+    if counters:
+      self.queue.restore_counters(**counters)
+    self.recorder.record("event", "loop_resumed", step=int(step))
+    return state, trees, meta
+
   # --- the loop ------------------------------------------------------------
 
   def run(self, num_steps: int) -> Dict:
@@ -723,6 +840,17 @@ class ReplayTrainLoop:
     sample/label/train) — the measured fallback."""
     c = self.config
     state = self.trainer.create_train_state(batch_size=c.batch_size)
+    # Crash-resume (ISSUE 14): restore the newest valid checkpoint —
+    # TrainState, lagged target, full ring state, counters, eval
+    # history — and continue from its exact step; nothing valid on
+    # disk means a fresh start.
+    start_step = 0
+    resume_trees = resume_meta = None
+    if c.resume and self._ckpt_manager is not None:
+      loaded = self._restore_checkpoint(state)
+      if loaded is not None:
+        state, resume_trees, resume_meta = loaded
+        start_step = int(resume_meta["step"])
     # Host snapshot feeds the collector predictor and the target net
     # (refreshed every K steps); the PER-STEP TD/eval path reads the
     # live device-resident state.variables() instead — a full D2H
@@ -742,6 +870,13 @@ class ReplayTrainLoop:
         iterations=c.cem_iterations, seed=c.seed + 13,
         polyak_tau=c.polyak_tau, ledger=self.obs_ledger,
         precision=c.precision)
+    if resume_meta is not None:
+      # The constructor seeded the target with the restored ONLINE
+      # params; re-seat the LAGGED target plus the label-seed counter
+      # so post-resume labels continue the interrupted streams.
+      updater.restore_target_state(resume_trees.get("target"),
+                                   resume_meta["target"])
+      updater.restore_label_seed(resume_meta["next_label_seed"])
 
     self._start_collectors(policy)
     profile_hook = self._profile_hook()
@@ -749,15 +884,24 @@ class ReplayTrainLoop:
     try:
       self._wait_for_min_fill()
       eval_batches, eval_q_stars = self._eval_transitions()
-      online = state.variables(use_ema=True)
-      initial_eval = self._eval(updater, online, eval_batches,
-                                eval_q_stars)
-      self._emit(0, {"replay/" + k: v for k, v in initial_eval.items()})
+      if resume_meta is None:
+        online = state.variables(use_ema=True)
+        initial_eval = self._eval(updater, online, eval_batches,
+                                  eval_q_stars)
+        self._emit(0, {"replay/" + k: v
+                       for k, v in initial_eval.items()})
+        eval_history = [dict(step=0, **initial_eval)]
+      else:
+        # The eval series continues the interrupted run's: the
+        # TD-reduction math must keep its ORIGINAL step-0 baseline,
+        # not re-baseline on already-trained params.
+        initial_eval = dict(resume_meta["initial_eval"])
+        eval_history = [dict(entry)
+                        for entry in resume_meta["eval_history"]]
 
       train_step = None
-      eval_history = [dict(step=0, **initial_eval)]
       final_metrics: Dict[str, float] = {}
-      for step in range(1, num_steps + 1):
+      for step in range(start_step + 1, num_steps + 1):
         with trace_lib.span("extend/drain"):
           self.feeder.drain()
         self._feeder_hb.beat()
@@ -816,6 +960,18 @@ class ReplayTrainLoop:
                                eval_q_stars)
           eval_history.append(dict(step=step, **evals))
           self._emit(step, {"replay/" + k: v for k, v in evals.items()})
+        if (self._ckpt_manager is not None and c.checkpoint_every
+            and step % c.checkpoint_every == 0):
+          self._save_checkpoint(step, state, updater, initial_eval,
+                                eval_history)
+        # Fault seam (ISSUE 14): a scheduled learner `crash` fires
+        # HERE, between optimizer steps — after any checkpoint this
+        # step owed, exactly where a preemption would land. The raise
+        # propagates through run()'s flightrec wrap; collectors shut
+        # down via the finally below.
+        if self._faults is not None:
+          self._faults.perturb("learner_step", site="learner",
+                               index=step)
     finally:
       self._profile_step(profile_hook, num_steps, final=True)
       collector_errors = self._shutdown_collectors()
@@ -1078,19 +1234,29 @@ class ReplayTrainLoop:
                                 / max(1, loop.episodes)))
 
   def _wait_for_min_fill(self) -> None:
-    """Gates the first optimizer step on buffer warm-up (min-fill)."""
-    deadline = time.monotonic() + self.config.min_fill_timeout_s
-    while not self.feeder.ready():
+    """Gates the first optimizer step on buffer warm-up (min-fill),
+    polling with the shared jittered backoff (utils/backoff.py) — and
+    on timeout raises a PollTimeout that NAMES the gate and the fill
+    it reached, instead of the old anonymous fixed-cadence spin."""
+    from tensor2robot_tpu.utils import backoff
+
+    def ready():
       self.feeder.drain()
       self._feeder_hb.beat()
       for collector in self._collectors:
         if collector.errors:
           raise RuntimeError("collector died during warm-up") from (
               collector.errors[0])
-      if time.monotonic() > deadline:
-        raise TimeoutError(
-            f"replay buffer failed to reach min_fill="
-            f"{self.config.min_fill} within "
-            f"{self.config.min_fill_timeout_s}s "
-            f"(size={self.buffer.size})")
-      time.sleep(0.05)
+      return self.feeder.ready()
+
+    try:
+      backoff.poll_with_backoff(
+          ready, self.config.min_fill_timeout_s,
+          initial_s=0.02, max_s=0.25, seed=self.config.seed,
+          description=(f"replay buffer min_fill="
+                       f"{self.config.min_fill} under {self.logdir}"),
+          raise_on_timeout=True)
+    except backoff.PollTimeout as e:
+      raise backoff.PollTimeout(
+          f"{e.description} (reached size={self.buffer.size})",
+          e.waited_s, e.attempts) from None

@@ -225,6 +225,67 @@ class ReplayBuffer:
       self._max_priority = max(self._max_priority,
                                float(priorities.max(initial=0.0)))
 
+  # --- checkpoint state (ISSUE 14: learner crash-resume) -------------------
+
+  def state_dict(self) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """(arrays, meta): everything needed to rebuild this ring bit-exactly
+    — storage, write cursor/size/append bookkeeping, priorities (the
+    sum tree rebuilds from its leaves), and the sampling rng's full
+    bit-generator state, so a restored buffer's sample() stream
+    CONTINUES the saved one (the resume-equals-uninterrupted parity
+    bar depends on exactly this)."""
+    with self._lock:
+      arrays = {f"storage/{key}": array.copy()
+                for key, array in self._storage.items()}
+      arrays["written_at"] = self._written_at.copy()
+      if self._tree is not None:
+        arrays["priorities"] = self._tree.leaves(self.capacity)
+      meta = {
+          "capacity": self.capacity,
+          "sample_batch_size": self.sample_batch_size,
+          "prioritized": self._prioritized,
+          "next": self._next,
+          "size": self._size,
+          "append_count": self._append_count,
+          "max_priority": self._max_priority,
+          "rng_state": self._rng.bit_generator.state,
+      }
+    return arrays, meta
+
+  def load_state_dict(self, arrays: Dict[str, np.ndarray],
+                      meta: Dict) -> None:
+    """Inverse of state_dict into THIS buffer (same spec/capacity/batch
+    — a drifted geometry refuses with the mismatch named, because a
+    silently reshaped ring would recompile every fixed-shape
+    consumer)."""
+    ours = {"capacity": self.capacity,
+            "sample_batch_size": self.sample_batch_size,
+            "prioritized": bool(self._prioritized)}
+    for field, value in ours.items():
+      saved = bool(meta[field]) if field == "prioritized" else meta[field]
+      if saved != value:
+        raise ValueError(
+            f"checkpointed buffer {field}={meta[field]} does not match "
+            f"this buffer's {value}; resume needs an identically "
+            "configured ring")
+    with self._lock:
+      for key, array in self._storage.items():
+        saved = np.asarray(arrays[f"storage/{key}"])
+        if saved.shape != array.shape or saved.dtype != array.dtype:
+          raise ValueError(
+              f"checkpointed storage {key!r} is {saved.dtype}"
+              f"{saved.shape}, ring expects {array.dtype}{array.shape}")
+        array[...] = saved
+      self._written_at[...] = np.asarray(arrays["written_at"], np.int64)
+      self._next = int(meta["next"])
+      self._size = int(meta["size"])
+      self._append_count = int(meta["append_count"])
+      self._max_priority = float(meta["max_priority"])
+      self._rng.bit_generator.state = meta["rng_state"]
+      if self._tree is not None:
+        leaves = np.asarray(arrays["priorities"], np.float64)
+        self._tree.set(np.arange(self.capacity, dtype=np.int64), leaves)
+
   # --- health metrics ------------------------------------------------------
 
   @property
@@ -384,6 +445,36 @@ class ShardedReplayBuffer:
       mask = shard_of == i
       if mask.any():
         shard.update_priorities(local[mask], td[mask])
+
+  def state_dict(self) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """Per-shard state under 'shard<i>/' key prefixes + the stripe
+    cursor (checkpoint/resume, same contract as ReplayBuffer's)."""
+    arrays: Dict[str, np.ndarray] = {}
+    shard_metas = []
+    for i, shard in enumerate(self._shards):
+      shard_arrays, shard_meta = shard.state_dict()
+      arrays.update({f"shard{i}/{key}": value
+                     for key, value in shard_arrays.items()})
+      shard_metas.append(shard_meta)
+    with self._lock:
+      stripe = self._stripe
+    return arrays, {"num_shards": self.num_shards, "stripe": stripe,
+                    "shards": shard_metas}
+
+  def load_state_dict(self, arrays: Dict[str, np.ndarray],
+                      meta: Dict) -> None:
+    if meta["num_shards"] != self.num_shards:
+      raise ValueError(
+          f"checkpointed num_shards={meta['num_shards']} does not "
+          f"match this buffer's {self.num_shards}")
+    for i, shard in enumerate(self._shards):
+      prefix = f"shard{i}/"
+      shard.load_state_dict(
+          {key[len(prefix):]: value for key, value in arrays.items()
+           if key.startswith(prefix)},
+          meta["shards"][i])
+    with self._lock:
+      self._stripe = int(meta["stripe"])
 
   @property
   def size(self) -> int:

@@ -36,10 +36,12 @@ from concurrent.futures import Future
 from typing import Any, Callable, Optional, Sequence
 
 from tensor2robot_tpu.obs import context as context_lib
+from tensor2robot_tpu.obs import faults as faults_lib
 from tensor2robot_tpu.obs import flight_recorder as flight_lib
 from tensor2robot_tpu.obs import trace as trace_lib
 from tensor2robot_tpu.obs import watchdog as watchdog_lib
-from tensor2robot_tpu.serving.slo import RequestShed, SLOClass
+from tensor2robot_tpu.serving.slo import (DispatcherDead, RequestShed,
+                                          SLOClass)
 from tensor2robot_tpu.serving.stats import ServingStats
 
 
@@ -103,7 +105,10 @@ class MicroBatcher:
                max_queue: Optional[int] = None,
                dispatch_margin_ms: float = 0.0,
                flight_recorder: Optional[flight_lib.FlightRecorder] = None,
-               watchdog: Optional[watchdog_lib.Watchdog] = None):
+               watchdog: Optional[watchdog_lib.Watchdog] = None,
+               fault_plan: Optional[faults_lib.FaultPlan] = None,
+               site: str = "batcher",
+               restart_budget: int = 3):
     """See class docstring. `dispatch_margin_ms` budgets the flush's own
     cost: a partial batch ships `margin` BEFORE its head's deadline, so
     a class's p99 can actually sit inside its budget (set it to a
@@ -115,7 +120,21 @@ class MicroBatcher:
     per-instance dispatcher heartbeat: beats per flush, idle while the
     queue is empty, so a dispatcher stuck with pending work (a wedged
     batch_fn, a hold that outlived its test) is flagged as a stall —
-    but only once the owning deployment STARTS the watchdog monitor."""
+    but only once the owning deployment STARTS the watchdog monitor.
+
+    `fault_plan` (ISSUE 14) is the deterministic injection seam: each
+    flush checks the plan's ``batcher_flush`` point under this
+    batcher's `site` before calling batch_fn — a ``hung_flush`` wedges
+    the flush, a ``thread_kill`` dies as a non-Exception exactly where
+    a poison request would. `restart_budget` bounds the self-healing
+    that answers it: a dead dispatcher thread is restarted up to this
+    many times (each death fails only its in-flight batch, typed, and
+    dumps to the flight recorder); past the budget the batcher goes
+    DOWN deliberately — every pending future resolves with
+    ``DispatcherDead`` (clients never hang on a dead dispatcher), new
+    submits raise, and the heartbeat is left armed-busy so a running
+    watchdog monitor escalates the outage instead of reading a dead
+    component as idle."""
     if max_batch < 1:
       raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     if deadline_ms < 0:
@@ -125,6 +144,9 @@ class MicroBatcher:
     if dispatch_margin_ms < 0:
       raise ValueError(
           f"dispatch_margin_ms must be >= 0, got {dispatch_margin_ms}")
+    if restart_budget < 0:
+      raise ValueError(
+          f"restart_budget must be >= 0, got {restart_budget}")
     self._batch_fn = batch_fn
     self._max_batch = max_batch
     self._margin_s = dispatch_margin_ms / 1e3
@@ -147,6 +169,13 @@ class MicroBatcher:
     self._thread: Optional[threading.Thread] = None
     self._release = threading.Event()  # hold_flushes gate; normally set
     self._release.set()
+    # Fault-tolerance state (ISSUE 14): the injection seam and the
+    # dispatcher-death recovery it exercises.
+    self._faults = fault_plan
+    self._site = site
+    self._restart_budget = restart_budget
+    self.dispatcher_restarts = 0
+    self.dispatcher_dead = False
     # Test-only observability (the zero-slack no-busy-spin regression
     # test): how many times the dispatcher loop body ran. A spinning
     # dispatcher shows unbounded growth while idle.
@@ -158,23 +187,39 @@ class MicroBatcher:
     with self._cond:
       if self._running:
         return self
+      if self.dispatcher_dead:
+        raise DispatcherDead("cannot restart a batcher that exhausted "
+                             "its dispatcher restart budget")
       self._running = True
     self._heartbeat = self._watchdog.register("serve/batcher")
-    self._thread = threading.Thread(
-        target=self._dispatch_loop, name="micro-batcher", daemon=True)
-    self._thread.start()
+    self._spawn_dispatcher()
     return self
 
+  def _spawn_dispatcher(self) -> None:
+    self._thread = threading.Thread(
+        target=self._dispatcher_main, name="micro-batcher", daemon=True)
+    self._thread.start()
+
   def stop(self) -> None:
-    """Stops accepting work, drains what is queued, joins the thread."""
+    """Stops accepting work, drains what is queued, joins the thread.
+
+    Safe on a batcher whose dispatcher already died (the heartbeat is
+    unregistered either way), and against a concurrent dispatcher
+    RESTART: the join loops until the thread reference stops changing,
+    so a death-and-respawn racing the stop cannot leak a live thread.
+    """
     with self._cond:
-      if not self._running:
-        return
       self._running = False
       self._cond.notify_all()
-    if self._thread is not None:
-      self._thread.join()
-      self._thread = None
+    while True:
+      thread = self._thread
+      if thread is None or thread is threading.current_thread():
+        break
+      thread.join()
+      if self._thread is thread:
+        self._thread = None
+        break
+      # A restart swapped the thread mid-join; join the successor too.
     if self._heartbeat is not None:
       self._watchdog.unregister(self._heartbeat)
       self._heartbeat = None
@@ -206,6 +251,15 @@ class MicroBatcher:
     """Pending + in-flight request count — the router's load signal."""
     with self._cond:
       return self._live + self._in_flight
+
+  def _raise_not_running_locked(self) -> None:
+    """A stopped batcher raises RuntimeError (the caller's lifecycle
+    bug); a DEAD one raises the typed DispatcherDead so the router's
+    fault machinery treats the synchronous submit failure exactly like
+    an asynchronous dispatch failure (retry elsewhere or shed_fault)."""
+    if self.dispatcher_dead:
+      raise DispatcherDead("restart budget exhausted; batcher is down")
+    raise RuntimeError("MicroBatcher is not running; call start().")
 
   @contextlib.contextmanager
   def hold_flushes(self):
@@ -262,15 +316,14 @@ class MicroBatcher:
       if request.deadline < request.enqueued_at:
         with self._cond:
           if not self._running:
-            raise RuntimeError(
-                "MicroBatcher is not running; call start().")
+            self._raise_not_running_locked()
         if self._stats is not None:
           self._stats.record_request(slo.name)
         self._shed(request, "expired")
         return request.future
       with self._cond:
         if not self._running:
-          raise RuntimeError("MicroBatcher is not running; call start().")
+          self._raise_not_running_locked()
         victim = None
         if self._max_queue is not None and self._live >= self._max_queue:
           victim = self._pick_victim_locked(request)
@@ -339,6 +392,77 @@ class MicroBatcher:
 
   # -- dispatcher ----------------------------------------------------------
 
+  def _dispatcher_main(self) -> None:
+    """Thread entry: the loop plus the DEATH handler (ISSUE 14). An
+    escaping non-Exception (a poison request aborting the thread, an
+    injected thread_kill) used to leave every queued client hanging —
+    now it either restarts the dispatcher (capped budget; the queue
+    survives, only the in-flight batch failed) or takes the batcher
+    down LOUDLY: all pending futures resolve DispatcherDead, and the
+    heartbeat stays armed-busy for the watchdog escalation."""
+    try:
+      self._dispatch_loop()
+    except BaseException as e:  # noqa: BLE001 — the death handler
+      self._on_dispatcher_death(e)
+
+  def _on_dispatcher_death(self, exc: BaseException) -> None:
+    detail = f"{type(exc).__name__}: {exc}"
+    with self._cond:
+      restart = (self._running
+                 and self.dispatcher_restarts < self._restart_budget)
+      if restart:
+        self.dispatcher_restarts += 1
+      else:
+        self.dispatcher_dead = True
+        self._running = False
+    self._recorder.trigger(
+        "batcher_dispatcher_death", site=self._site, error=detail,
+        restarts=self.dispatcher_restarts,
+        restart_budget=self._restart_budget, recovered=restart)
+    try:
+      from tensor2robot_tpu.obs import registry as registry_lib
+      registry_lib.get_registry().counter(
+          "serving/dispatcher_restarts" if restart
+          else "serving/dispatcher_deaths").inc()
+    except Exception:
+      pass  # diagnostics never block the recovery path
+    if restart:
+      # The queue (and its futures) survive: only the batch that was
+      # in flight when the thread died has already been failed typed.
+      self._spawn_dispatcher()
+      return
+    # Unrecoverable: resolve EVERY pending future — a dead dispatcher
+    # must never leave a client blocked in result(). The heartbeat is
+    # deliberately left registered and flipped busy: a component that
+    # is down with work it will never do is a stall, and a running
+    # watchdog monitor escalates it (counter -> dump -> callback);
+    # stop() unregisters it when the owner shuts the batcher down.
+    self._fail_all_pending(DispatcherDead(detail))
+    heartbeat = self._heartbeat
+    if heartbeat is not None:
+      heartbeat.busy()
+
+  @staticmethod
+  def _resolve_failed(future: Future, exc: Exception) -> None:
+    """Best-effort typed resolution for a future in ANY state:
+    set_exception lands from PENDING and RUNNING alike; a future the
+    client already cancelled (or a flush already resolved) is left
+    alone — the death paths must never themselves raise on a racing
+    client."""
+    try:
+      future.set_exception(exc)
+    except Exception:
+      pass
+
+  def _fail_all_pending(self, exc: Exception) -> None:
+    with self._cond:
+      pending = [request for _, _, request in self._heap
+                 if not request.shed]
+      self._heap.clear()
+      self._live = 0
+    for request in pending:
+      self._resolve_failed(request.future, exc)
+
   def _dispatch_loop(self) -> None:
     while True:
       batch, deadline_expired = self._next_batch()
@@ -357,6 +481,13 @@ class MicroBatcher:
               request.future.set_exception(e)
             except Exception:
               pass
+      except BaseException as e:  # dying — but THIS batch still
+        # resolves typed before the death handler decides the
+        # batcher's fate (clients of the killed flush never hang).
+        detail = f"{type(e).__name__}: {e}"
+        for request in batch:
+          self._resolve_failed(request.future, DispatcherDead(detail))
+        raise
       finally:
         with self._cond:
           self._in_flight -= len(batch)
@@ -434,6 +565,13 @@ class MicroBatcher:
     # out into per-request flows.
     batch_ids = context_lib.join_ids(r.request_id for r in batch)
     with context_lib.bind(request_ids=batch_ids):
+      # Fault seam (ISSUE 14): the ONE point a scheduled hung_flush or
+      # thread_kill enters this batcher. Inside the bind, so the
+      # fault's flight-recorder dump carries the batch's correlation
+      # ids; a kill raised here is failed typed by the dispatch loop's
+      # death path (_resolve_failed handles the RUNNING futures).
+      if self._faults is not None:
+        self._faults.perturb("batcher_flush", site=self._site)
       with trace_lib.span("serve/flush", batch=len(batch)):
         try:
           results = self._batch_fn([r.item for r in batch])

@@ -47,6 +47,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from tensor2robot_tpu.obs import context as context_lib
+from tensor2robot_tpu.obs import faults as faults_lib
 from tensor2robot_tpu.obs import flight_recorder as flight_lib
 from tensor2robot_tpu.obs import watchdog as watchdog_lib
 from tensor2robot_tpu.serving.batcher import MicroBatcher
@@ -57,7 +58,7 @@ _log = logging.getLogger(__name__)
 
 
 class ExportWatcher:
-  """Finds new candidate params in the async-export hook's output dir.
+  """Finds and VALIDATES new candidate params in the export dir.
 
   Pull: ``poll()`` lists the export root's versioned dirs
   (export_utils.list_export_versions — the layout export_and_gc
@@ -66,14 +67,39 @@ class ExportWatcher:
   is shaped for `AsyncExportHook(on_export=...)` so a co-resident
   trainer skips the poll latency. Either way the controller receives
   ``(version, variables)``.
+
+  Validation gate (ISSUE 14): every candidate is structurally checked
+  BEFORE it can enter a rollout — required files present, no
+  mid-publish tmp markers, the variables npz actually parses (a
+  truncated partial write fails the zip CRC here, not as a corrupted
+  tree inside a shadow flush). A rejected dir triggers a
+  flight-recorder record (reason ``export_rejected``, naming the dir
+  and the failure) and is NEVER swapped in; it is retried on later
+  polls (a mid-publish dir completes; a genuinely corrupt one keeps
+  losing to the next good version, and its rejection stays in the
+  ring for the post-mortem). ``fault_plan`` is the deterministic
+  corruption seam: a scheduled ``export_corrupt`` /
+  ``export_partial_write`` damages the candidate on disk exactly once
+  at the load boundary, so the rejection path is a reproducible test
+  input.
   """
 
   def __init__(self, export_root: str,
-               load_fn: Optional[Callable[[str], dict]] = None):
+               load_fn: Optional[Callable[[str], dict]] = None,
+               validate_fn: Optional[Callable[[str], None]] = None,
+               fault_plan=None,
+               flight_recorder=None):
     self._export_root = export_root
     self._load_fn = load_fn or self._load_native
+    # Structural validation only applies to the layout we load; a
+    # custom load_fn supplies its own (or relies on the load raising).
+    self._validate_fn = validate_fn or (
+        self._validate_native if load_fn is None else None)
+    self._faults = fault_plan
+    self._recorder = flight_recorder or flight_lib.get_recorder()
     self._seen = -1
     self._pushed: "queue.Queue" = queue.Queue()
+    self.rejections: List[dict] = []
 
   @staticmethod
   def _load_native(export_dir: str) -> dict:
@@ -83,15 +109,55 @@ class ExportWatcher:
     return variables_io.load_variables(
         os.path.join(export_dir, VARIABLES_NPZ))
 
+  @staticmethod
+  def _validate_native(export_dir: str) -> None:
+    """Raises ValueError naming the defect when `export_dir` is not a
+    complete, finalized native export: missing dir, missing variables
+    npz, or a mid-publish tmp marker. Structural checks ONLY —
+    truncation/corruption of the npz itself is caught by the LOAD one
+    call later (numpy validates the zip central directory and
+    per-entry CRCs on read; poll() routes that failure into the same
+    rejection path), so validating the bytes here would read the full
+    parameter set twice per accepted export for no extra protection."""
+    from tensor2robot_tpu.export.native_export_generator import (
+        VARIABLES_NPZ)
+    if not os.path.isdir(export_dir):
+      raise ValueError(f"export dir {export_dir} does not exist")
+    entries = os.listdir(export_dir)
+    tmp = [e for e in entries if "tmp" in e.lower()]
+    if tmp:
+      raise ValueError(
+          f"export dir {export_dir} carries mid-publish tmp "
+          f"markers: {tmp}")
+    npz_path = os.path.join(export_dir, VARIABLES_NPZ)
+    if not os.path.isfile(npz_path):
+      raise ValueError(f"export dir {export_dir} has no "
+                       f"{VARIABLES_NPZ}")
+
   def notify(self, export_dir: str, step: int) -> None:
     """Push entry (the AsyncExportHook on_export signature)."""
     self._pushed.put((int(step), export_dir))
 
+  def _reject(self, version: int, export_dir: str, reason: str) -> None:
+    entry = {"version": version, "export_dir": export_dir,
+             "reason": reason}
+    self.rejections.append(entry)
+    _log.warning("export %s rejected: %s (will retry on later polls)",
+                 export_dir, reason)
+    try:
+      # `detail`, not `reason`: the recorder's positional `reason` IS
+      # the trigger name.
+      self._recorder.trigger("export_rejected", version=version,
+                             export_dir=export_dir, detail=reason)
+    except Exception:
+      pass  # diagnostics never poison the watcher
+
   def poll(self):
-    """Returns (version, variables) for the newest unseen export, else
-    None. Pushed notifications win over directory listing; a load
-    failure (export mid-publish, half-written npz) is logged and
-    retried on the next poll rather than poisoning the controller."""
+    """Returns (version, variables) for the newest unseen VALID export,
+    else None. Pushed notifications win over directory listing; a
+    rejected candidate (partial/corrupt/mid-publish — see class
+    docstring) is recorded and retried on the next poll rather than
+    poisoning the controller or, worse, entering a rollout."""
     candidate = None
     while True:  # drain pushes, keep the newest
       try:
@@ -110,10 +176,24 @@ class ExportWatcher:
     if candidate is None or candidate[0] <= self._seen:
       return None
     version, export_dir = candidate
+    # Deterministic corruption seam (obs/faults.py): a scheduled
+    # export fault damages THIS candidate on disk before validation —
+    # the rejection below is then a reproducible chaos-test input.
+    if self._faults is not None:
+      for spec in self._faults.check("export_load", site=str(version)):
+        if spec.kind in ("export_corrupt", "export_partial_write"):
+          faults_lib.damage_export(export_dir, spec.kind)
+    if self._validate_fn is not None:
+      try:
+        self._validate_fn(export_dir)
+      except Exception as e:
+        self._reject(version, export_dir, f"{type(e).__name__}: {e}")
+        return None
     try:
       variables = self._load_fn(export_dir)
-    except Exception:
-      _log.exception("candidate %s unreadable; will retry", export_dir)
+    except Exception as e:
+      self._reject(version, export_dir,
+                   f"load failed: {type(e).__name__}: {e}")
       return None
     self._seen = version
     return version, variables

@@ -35,12 +35,15 @@ from typing import Optional, Sequence
 import numpy as np
 
 from tensor2robot_tpu.obs import context as context_lib
+from tensor2robot_tpu.obs import faults as faults_lib
 from tensor2robot_tpu.obs import flight_recorder as flight_lib
 from tensor2robot_tpu.obs import ledger as ledger_lib
 from tensor2robot_tpu.obs import trace as trace_lib
 from tensor2robot_tpu.serving.batcher import MicroBatcher
 from tensor2robot_tpu.serving.policy import CEMFleetPolicy
-from tensor2robot_tpu.serving.slo import SLOClass
+from tensor2robot_tpu.serving import slo as slo_lib
+from tensor2robot_tpu.serving.slo import (HealthConfig, RequestShed,
+                                          SLOClass)
 from tensor2robot_tpu.serving.stats import ServingStats
 
 
@@ -50,14 +53,19 @@ class PolicyReplica:
   def __init__(self, policy: CEMFleetPolicy, max_batch: int,
                deadline_ms: float, stats: ServingStats,
                max_queue: Optional[int], dispatch_margin_ms: float,
-               flight_recorder=None):
+               flight_recorder=None,
+               fault_plan: Optional[faults_lib.FaultPlan] = None,
+               restart_budget: int = 3):
     self.policy = policy
     self.device = policy.device
+    self._faults = fault_plan
     self.batcher = MicroBatcher(
         self._flush, max_batch=max_batch, deadline_ms=deadline_ms,
         stats=stats, bucket_for=policy.ladder.bucket_for,
         max_queue=max_queue, dispatch_margin_ms=dispatch_margin_ms,
-        flight_recorder=flight_recorder)
+        flight_recorder=flight_recorder,
+        fault_plan=fault_plan, site=f"batcher@{policy.device}",
+        restart_budget=restart_budget)
 
   def use_policy(self, policy: CEMFleetPolicy) -> None:
     """Hot-swaps this replica's policy (the precision-tier promotion
@@ -82,6 +90,13 @@ class PolicyReplica:
     # actually landed on.
     with trace_lib.span("serve/dispatch", batch=len(items),
                         device=str(self.device)):
+      # Fault seam (ISSUE 14): the ONE point a scheduled
+      # dispatch_error / latency_spike enters this replica — inside
+      # the dispatch span, so the injected fault's flight-recorder
+      # dump carries the batch's request_ids, and upstream sees
+      # exactly what a real device failure produces (a raising flush).
+      if self._faults is not None:
+        self._faults.perturb("replica_dispatch", site=str(self.device))
       return list(self.policy(images, seeds))
 
   def warmup(self, make_image) -> None:
@@ -116,6 +131,23 @@ class FleetRouter:
       for the shadow/canary phases. Non-f32 executables register
       tier-suffixed ledger keys, so the shared obs ledger proves
       exactly-once compilation per bucket per device PER TIER.
+    health: replica self-healing knobs (serving/slo.HealthConfig,
+      ISSUE 14). Always armed — with no failures the machinery is
+      inert (each success is one counter reset) and dispatch behaves
+      exactly as before: per replica, a consecutive-failure circuit
+      breaker QUARANTINES a throwing replica out of the least-loaded
+      candidate set; after `quarantine_s` ONE live request is routed
+      to it as a half-open PROBE (success reinstates, failure
+      re-quarantines); a failed dispatch re-routes to another replica
+      only when the request's remaining deadline slack covers
+      `retry_cost_ms` (else it resolves typed as
+      ``RequestShed(class, "fault")``, counted per class); and with
+      EVERY replica quarantined the router degrades — it keeps
+      routing least-loaded over the quarantined fleet so the existing
+      SLO machinery sheds lowest-priority-first instead of erroring.
+    fault_plan: deterministic fault injection (obs/faults.py) threaded
+      to every replica's dispatch seam and batcher. None (the
+      default) is the oracle path: no plan, no new work on dispatch.
     cem / ladder kwargs: forwarded to each replica's CEMFleetPolicy.
   """
 
@@ -130,7 +162,9 @@ class FleetRouter:
                metric_writer=None,
                ledger: Optional[ledger_lib.ExecutableLedger] = None,
                flight_recorder=None,
-               precision: str = "f32"):
+               precision: str = "f32",
+               health: Optional[HealthConfig] = None,
+               fault_plan: Optional[faults_lib.FaultPlan] = None):
     import jax
 
     from tensor2robot_tpu.research.qtopt import cem
@@ -170,7 +204,18 @@ class FleetRouter:
     # rollout cycles.
     self._policy_cache = {}
     self._policy_cache_lock = threading.Lock()
+    # Replica self-healing (ISSUE 14): one circuit breaker per replica
+    # under one health lock; the timeline feeds the chaos artifact's
+    # quarantine→probe→reinstate bar.
+    self.health = health or HealthConfig()
+    self._faults = fault_plan
+    self._health_lock = threading.Lock()
+    self._health_events = []
+    self._max_health_events = 1024
+    self._degraded = False
+    self._started_at = time.perf_counter()
     self.replicas = []
+    self._breakers = []
     for device in devices:
       policy = self.make_policy(device)
       ladder = policy.ladder
@@ -182,7 +227,11 @@ class FleetRouter:
             f"{ladder.max_batch}")
       self.replicas.append(PolicyReplica(
           policy, replica_max_batch, deadline_ms, self.stats, max_queue,
-          dispatch_margin_ms, flight_recorder=self._recorder))
+          dispatch_margin_ms, flight_recorder=self._recorder,
+          fault_plan=fault_plan,
+          restart_budget=self.health.restart_budget))
+      self._breakers.append(slo_lib.CircuitBreaker(
+          self.health.failure_threshold, self.health.quarantine_s))
 
   def make_policy(self, device, precision: Optional[str] = None
                   ) -> CEMFleetPolicy:
@@ -306,7 +355,7 @@ class FleetRouter:
              seed: Optional[int] = None,
              deadline_at: Optional[float] = None,
              request_id: Optional[str] = None) -> Future:
-    """Enqueues one frame on the least-loaded replica.
+    """Enqueues one frame on the least-loaded AVAILABLE replica.
 
     The request's absolute deadline is stamped HERE (router ingress),
     so replica queueing cannot silently extend a class budget: if the
@@ -318,25 +367,266 @@ class FleetRouter:
     mirror copy inherits its parent's id), bound for the routing
     decision, and threaded onto the replica's pending record — every
     span and flight-recorder trigger the request touches carries it.
+
+    Self-healing (ISSUE 14): the returned future is ROUTER-owned. A
+    replica dispatch failure (not a shed) feeds that replica's circuit
+    breaker and — when the request's remaining deadline slack covers
+    ``health.retry_cost_ms`` and the retry budget allows — re-routes
+    the request to another replica transparently; otherwise the future
+    resolves ``RequestShed(class, "fault")``. Quarantined replicas are
+    out of the candidate set; a due half-open probe routes ONE live
+    request back to its replica; with the whole fleet quarantined the
+    router degrades to least-loaded over everyone (the SLO machinery
+    sheds lowest-priority-first) instead of erroring. A client only
+    ever sees a result, a typed ``RequestShed``, or its own timeout —
+    never a raw replica exception. (Per-class ServingStats request
+    counters count dispatch ATTEMPTS — a retried request is two — and
+    a request shed as "fault" after a synchronous submit failure may
+    carry no matching attempt; logical-request accounting lives in the
+    benches' client-side completion counters.)
     """
     if slo is not None and deadline_at is None:
       deadline_at = time.perf_counter() + slo.deadline_ms / 1e3
     seed = self.assign_seed() if seed is None else int(seed)
     request_id = request_id or context_lib.new_request_id()
+    outer: Future = Future()
+    self._dispatch(outer, np.asarray(image), seed, slo, deadline_at,
+                   request_id, excluded=frozenset(), retries=0)
+    return outer
+
+  # -- self-healing dispatch (ISSUE 14) ------------------------------------
+
+  def _health_event(self, event: str, replica: Optional[int],
+                    **fields) -> None:
+    """Appends one entry to the health timeline. Caller holds the
+    health lock; flight-recorder triggers for the entries that warrant
+    one (quarantine) are fired by the caller AFTER releasing it."""
+    entry = {
+        "event": event,
+        "t_s": round(time.perf_counter() - self._started_at, 3),
+    }
+    if replica is not None:
+      entry["replica"] = str(self.replicas[replica].device)
+    entry.update(fields)
+    self._health_events.append(entry)
+    # Bounded like the watchdog's event history: a long-lived router
+    # under flapping faults must not grow its timeline without bound.
+    if len(self._health_events) > self._max_health_events:
+      del self._health_events[
+          :len(self._health_events) - self._max_health_events]
+
+  def _update_degraded_locked(self) -> None:
+    degraded = all(b.state != "closed" for b in self._breakers)
+    if degraded and not self._degraded:
+      self._degraded = True
+      self._health_event("degraded_enter", None)
+    elif not degraded and self._degraded:
+      self._degraded = False
+      self._health_event("degraded_exit", None)
+
+  def _record_result(self, index: int, ok: bool,
+                     error: Optional[str] = None) -> None:
+    """Feeds one dispatch outcome into the replica's breaker; emits
+    timeline events + flightrec triggers on state transitions."""
+    with self._health_lock:
+      breaker = self._breakers[index]
+      before = breaker.state
+      if ok:
+        # `from_degraded` gates the open->closed shortcut: only a
+        # success of traffic the router ROUTED to an open replica
+        # (degraded mode) reinstates without a probe — a stale
+        # completion of a request queued before the quarantine must
+        # not bypass the window (slo.CircuitBreaker.record_success).
+        breaker.record_success(from_degraded=self._degraded)
+      else:
+        breaker.record_failure()
+      after = breaker.state
+      if before != "open" and after == "open":
+        self._health_event(
+            "requarantine" if before == "half_open" else "quarantine",
+            index, failures=breaker.consecutive_failures,
+            **({} if error is None else {"error": error}))
+      elif before in ("open", "half_open") and after == "closed":
+        self._health_event("reinstate", index)
+      self._update_degraded_locked()
+      quarantined = (before != "open" and after == "open")
+      degraded = self._degraded
+    if quarantined:
+      # A replica leaving the fleet is a post-mortem trigger: the dump
+      # carries the spans/faults that tripped the breaker.
+      try:
+        self._recorder.trigger(
+            "replica_quarantined",
+            replica=str(self.replicas[index].device),
+            degraded=degraded)
+      except Exception:
+        pass
+
+  def _choose_replica(self, excluded: frozenset) -> tuple:
+    """(index, is_probe): a due half-open probe wins (one live request
+    reinstates or re-quarantines its replica), else least-loaded over
+    the CLOSED replicas, else — fleet fully quarantined — degraded
+    least-loaded over everyone not excluded. `excluded` holds replicas
+    this request already failed on (retries must actually re-route).
+    """
+    n = len(self.replicas)
+    with self._health_lock:
+      now = time.monotonic()
+      for i in range(n):
+        if i in excluded:
+          continue
+        breaker = self._breakers[i]
+        if breaker.state != "closed" and breaker.allows(now):
+          self._health_event("probe", i)
+          return i, True
+      candidates = [i for i in range(n)
+                    if i not in excluded
+                    and self._breakers[i].state == "closed"]
+      if not candidates:
+        # Degraded mode: everything quarantined (or excluded). Keep
+        # serving — route over the quarantined fleet minus exclusions
+        # and let the SLO machinery shed lowest-priority-first under
+        # whatever capacity remains. Non-empty by construction: the
+        # initial dispatch excludes nothing and _retry_or_shed only
+        # re-dispatches while len(excluded) < n.
+        self._update_degraded_locked()
+        candidates = [i for i in range(n) if i not in excluded]
+    # Least-loaded with the ROTATING tie-break: bare min() resolves
+    # every tie to replica 0, hot-spotting one device whenever queues
+    # are equal (an idle fleet, or all-full under overload — where it
+    # also concentrates every eviction on one replica's queue).
+    offset = next(self._rr)
+    index = min(
+        ((self.replicas[i].batcher.pending(), (i - offset) % n, i)
+         for i in candidates),
+        key=lambda entry: entry[:2])[2]
+    return index, False
+
+  def _dispatch(self, outer: Future, image, seed: int,
+                slo: Optional[SLOClass], deadline_at: Optional[float],
+                request_id: str, excluded: frozenset,
+                retries: int) -> None:
+    index, is_probe = self._choose_replica(excluded)
+    replica = self.replicas[index]
     with context_lib.bind(request_id=request_id):
-      # Least-loaded with a ROTATING tie-break: bare min() resolves
-      # every tie to replica 0, hot-spotting one device whenever queues
-      # are equal (an idle fleet, or all-full under overload — where it
-      # also concentrates every eviction on one replica's queue).
-      offset = next(self._rr)
-      n = len(self.replicas)
-      replica = min(
-          ((r.batcher.pending(), (i - offset) % n, r)
-           for i, r in enumerate(self.replicas)),
-          key=lambda entry: entry[:2])[2]
-      return replica.batcher.submit(
-          (np.asarray(image), seed), slo=slo, deadline_at=deadline_at,
-          request_id=request_id)
+      try:
+        inner = replica.batcher.submit(
+            (image, seed), slo=slo, deadline_at=deadline_at,
+            request_id=request_id)
+      except Exception as e:
+        # Synchronous failure (a dead batcher's DispatcherDead): the
+        # same accounting as an async dispatch failure. RuntimeError
+        # from a merely-stopped batcher counts too — a stopped replica
+        # is as unavailable as a dead one.
+        self._record_result(index, ok=False,
+                            error=f"{type(e).__name__}: {e}")
+        self._retry_or_shed(outer, image, seed, slo, deadline_at,
+                            request_id, excluded | {index}, retries, e)
+        return
+    inner.add_done_callback(
+        lambda f: self._on_dispatched(
+            f, outer, index, is_probe, image, seed, slo, deadline_at,
+            request_id, excluded, retries))
+
+  def _on_dispatched(self, inner: Future, outer: Future, index: int,
+                     is_probe: bool, image, seed, slo, deadline_at,
+                     request_id, excluded: frozenset,
+                     retries: int) -> None:
+    try:
+      result = inner.result()
+    except RequestShed as e:
+      # Admission-control sheds are NOT replica faults: the breaker
+      # ignores them (an overloaded-but-correct replica must not end
+      # up quarantined), and the shed passes through typed. A shed
+      # PROBE produced no verdict either way — release the probe slot
+      # or the replica stays half-open (and out of the fleet) forever.
+      if is_probe:
+        with self._health_lock:
+          self._breakers[index].release_probe()
+      self._resolve_outer(outer, error=e)
+      return
+    except Exception as e:
+      self._record_result(index, ok=False,
+                          error=f"{type(e).__name__}: {e}")
+      self._retry_or_shed(outer, image, seed, slo, deadline_at,
+                          request_id, excluded | {index}, retries, e)
+      return
+    self._record_result(index, ok=True)
+    self._resolve_outer(outer, result=result)
+
+  def _retry_or_shed(self, outer: Future, image, seed, slo, deadline_at,
+                     request_id, excluded: frozenset, retries: int,
+                     error: Exception) -> None:
+    """Deadline-aware retry: re-route only when the remaining slack
+    covers one more dispatch AND budget/replicas remain; else resolve
+    the client typed (RequestShed "fault") — never a raw exception,
+    never a doomed retry returning a dead answer late."""
+    n = len(self.replicas)
+    remaining_ms = (None if deadline_at is None
+                    else (deadline_at - time.perf_counter()) * 1e3)
+    slack_ok = (remaining_ms is None
+                or remaining_ms >= self.health.retry_cost_ms)
+    can_retry = (retries < self.health.max_retries and slack_ok
+                 and len(excluded) < n)
+    if can_retry:
+      try:
+        from tensor2robot_tpu.obs import registry as registry_lib
+        registry_lib.get_registry().counter("serving/retries").inc()
+      except Exception:
+        pass
+      with self._health_lock:
+        self._health_event("retry", None, request_id=request_id,
+                          attempt=retries + 1)
+      self._dispatch(outer, image, seed, slo, deadline_at, request_id,
+                     excluded, retries + 1)
+      return
+    class_name = slo.name if slo is not None else "default"
+    reason_detail = (f"{type(error).__name__}: {error} "
+                     f"(retries={retries}, slack_ms="
+                     f"{None if remaining_ms is None else round(remaining_ms, 1)})")
+    self.stats.record_shed(class_name, "fault")
+    try:
+      self._recorder.trigger("slo_breach", slo_class=class_name,
+                             shed_reason="fault",
+                             request_id=request_id)
+    except Exception:
+      pass
+    self._resolve_outer(
+        outer, error=RequestShed(class_name, "fault",
+                                 detail=reason_detail))
+
+  @staticmethod
+  def _resolve_outer(outer: Future, result=None, error=None) -> None:
+    if outer.done():
+      return  # client cancelled; the answer has no audience
+    if not outer.set_running_or_notify_cancel():
+      return
+    try:
+      if error is not None:
+        outer.set_exception(error)
+      else:
+        outer.set_result(result)
+    except Exception:
+      pass
+
+  def health_snapshot(self) -> dict:
+    """Per-replica breaker states + the transition timeline — the
+    chaos artifact's quarantine→probe→reinstate evidence."""
+    with self._health_lock:
+      return {
+          "replicas": {
+              str(replica.device): {
+                  "state": breaker.state,
+                  "consecutive_failures": breaker.consecutive_failures,
+                  "dispatcher_restarts":
+                      replica.batcher.dispatcher_restarts,
+                  "dispatcher_dead": replica.batcher.dispatcher_dead,
+              }
+              for replica, breaker in zip(self.replicas, self._breakers)
+          },
+          "degraded": self._degraded,
+          "timeline": [dict(entry) for entry in self._health_events],
+      }
 
   def act(self, image, slo: Optional[SLOClass] = None,
           timeout: Optional[float] = None) -> np.ndarray:
@@ -364,6 +654,7 @@ class FleetRouter:
     out["compile_ledger"] = self.compile_ledger()
     out["replica_pending"] = [replica.batcher.pending()
                               for replica in self.replicas]
+    out["health"] = self.health_snapshot()
     return out
 
   def write_metrics(self, step: Optional[int] = None) -> None:

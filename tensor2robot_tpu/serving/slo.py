@@ -27,7 +27,8 @@ trades padding waste and shed rate against per-class p99.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+import time
+from typing import List, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,7 +67,9 @@ class RequestShed(RuntimeError):
 
   Carries the class name and the reason ("expired" — the deadline was
   already past at enqueue; "capacity" — offered load exceeded the queue
-  bound and this request was the lowest-priority victim). Clients treat
+  bound and this request was the lowest-priority victim; "fault" — a
+  replica dispatch failed and the request's remaining deadline slack
+  could not cover a retry on another replica, ISSUE 14). Clients treat
   it as an explicit, *accounted* overload signal, distinct from a
   server fault: the action is to retry later or degrade, not to crash.
   """
@@ -79,3 +82,162 @@ class RequestShed(RuntimeError):
     if detail:
       message += f": {detail}"
     super().__init__(message)
+
+
+class DispatcherDead(RuntimeError):
+  """Resolved into every pending Future when a MicroBatcher's
+  dispatcher thread dies unrecoverably (restart budget exhausted, or a
+  death during shutdown). A TYPED terminal error, not a hang: before
+  ISSUE 14, a dispatcher killed by a non-``Exception`` (a poison
+  request aborting the thread) left every queued client blocked in
+  ``result()`` forever — the worst failure mode a serving tier has,
+  because it is invisible until the robots stop moving. Clients treat
+  it like an infrastructure fault: re-resolve against another replica
+  (the router's deadline-aware retry does exactly that) or fail fast.
+  """
+
+  def __init__(self, detail: str = ""):
+    message = "batcher dispatcher thread died unrecoverably"
+    if detail:
+      message += f": {detail}"
+    super().__init__(message)
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+  """Knobs for the router's replica self-healing (ISSUE 14).
+
+  Attributes:
+    failure_threshold: consecutive dispatch failures that open a
+      replica's circuit breaker (quarantine). Consecutive, not
+      windowed: one success resets the count, so a replica that is
+      merely slow under load never accumulates its way into
+      quarantine.
+    quarantine_s: how long an opened breaker holds the replica out of
+      the least-loaded candidate set before allowing a HALF-OPEN
+      probe. A probe is a live request (not synthetic traffic): its
+      success closes the breaker (reinstate), its failure re-opens it
+      for another quarantine_s.
+    retry_cost_ms: the router's estimate of one re-dispatch
+      (enqueue + flush + device call) — a failed request re-routes to
+      another replica ONLY if its remaining deadline slack covers
+      this; otherwise it is shed as ``RequestShed(class, "fault")``
+      (typed and counted, never a doomed retry that returns a dead
+      answer late).
+    max_retries: re-dispatch budget per request across replicas.
+    restart_budget: per-replica dispatcher-thread restart budget
+      (MicroBatcher): a dispatcher killed by a poison request is
+      restarted up to this many times; past it the batcher fails every
+      pending Future with DispatcherDead and stays down (the watchdog
+      escalation takes over — its heartbeat is left armed-busy so a
+      running monitor pages).
+  """
+
+  failure_threshold: int = 3
+  quarantine_s: float = 2.0
+  retry_cost_ms: float = 50.0
+  max_retries: int = 2
+  restart_budget: int = 3
+
+
+class CircuitBreaker:
+  """Per-replica consecutive-failure breaker: closed → open (quarantine)
+  → half-open (one probe) → closed, the textbook state machine made
+  deterministic for tests (every transition takes an injectable
+  ``now``; the monotonic clock is only a default).
+
+  Not thread-safe by itself — the router serializes calls under its
+  health lock (breaker methods are pure bookkeeping, never blocking).
+  """
+
+  def __init__(self, failure_threshold: int = 3,
+               quarantine_s: float = 2.0):
+    if failure_threshold < 1:
+      raise ValueError(
+          f"failure_threshold must be >= 1, got {failure_threshold}")
+    if quarantine_s < 0:
+      raise ValueError(f"quarantine_s must be >= 0, got {quarantine_s}")
+    self.failure_threshold = failure_threshold
+    self.quarantine_s = quarantine_s
+    self.state = "closed"
+    self.consecutive_failures = 0
+    self.opened_at: Optional[float] = None
+    self.events: List[dict] = []  # transition history (artifact-ready)
+    self._probe_in_flight = False
+
+  def _transition(self, state: str, now: float, **fields) -> None:
+    self.state = state
+    self.events.append({"state": state, "t": now, **fields})
+    if len(self.events) > 256:  # bounded: a flapping replica must not
+      del self.events[:len(self.events) - 256]  # grow this unbounded
+
+  def record_success(self, now: Optional[float] = None,
+                     from_degraded: bool = False) -> None:
+    """A dispatch served by this replica succeeded. `from_degraded`
+    marks a success of a request ROUTED to this replica while open
+    (the router's degraded mode — the whole fleet quarantined):
+    conclusive health evidence, reinstate immediately. Without the
+    flag, a success while open is a STALE completion — a request that
+    was already queued on the replica's batcher before the breaker
+    tripped — and must not short-circuit the quarantine window (a
+    replica failing every Nth flush under sustained load would
+    otherwise never stay quarantined); it only resets the consecutive
+    count, and the half-open probe still decides reinstatement."""
+    now = time.monotonic() if now is None else now
+    self.consecutive_failures = 0
+    if self.state == "half_open":
+      # The probe came back healthy: reinstate.
+      self._probe_in_flight = False
+      self.opened_at = None
+      self._transition("closed", now, reason="probe_succeeded")
+    elif self.state == "open" and from_degraded:
+      self.opened_at = None
+      self._transition("closed", now, reason="degraded_success")
+
+  def record_failure(self, now: Optional[float] = None) -> None:
+    """A dispatch served by this replica failed (non-shed)."""
+    now = time.monotonic() if now is None else now
+    self.consecutive_failures += 1
+    if self.state == "half_open":
+      # The probe failed: back to quarantine for a fresh window.
+      self._probe_in_flight = False
+      self.opened_at = now
+      self._transition("open", now, reason="probe_failed")
+    elif (self.state == "closed"
+          and self.consecutive_failures >= self.failure_threshold):
+      self.opened_at = now
+      self._transition("open", now, reason="threshold",
+                       failures=self.consecutive_failures)
+
+  def allows(self, now: Optional[float] = None) -> bool:
+    """True when the replica may receive ordinary traffic (closed), or
+    when the quarantine window has elapsed and THIS call claims the
+    one half-open probe slot (the caller routes the current request to
+    the replica as the probe). While a probe is in flight, further
+    calls return False — one probe at a time, so a recovering replica
+    is not stampeded."""
+    now = time.monotonic() if now is None else now
+    if self.state == "closed":
+      return True
+    if self.state == "open":
+      if (self.opened_at is not None
+          and now - self.opened_at >= self.quarantine_s):
+        self._probe_in_flight = True
+        self._transition("half_open", now, reason="quarantine_elapsed")
+        return True
+      return False
+    # half_open: exactly one probe outstanding.
+    if not self._probe_in_flight:
+      self._probe_in_flight = True
+      return True
+    return False
+
+  def release_probe(self) -> None:
+    """The probe produced NO verdict (the request was shed by
+    admission control before reaching the device): free the slot so a
+    later request can probe. Without this, a shed probe would leave
+    _probe_in_flight latched and the replica quarantined forever —
+    neither success nor failure evidence, so the state stays
+    half_open."""
+    if self.state == "half_open":
+      self._probe_in_flight = False

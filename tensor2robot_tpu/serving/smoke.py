@@ -96,7 +96,8 @@ class TinyQPredictor(AbstractPredictor):
 
   # -- AbstractPredictor contract -----------------------------------------
 
-  def restore(self, timeout_s: float = 0.0) -> bool:
+  def restore(self, timeout_s: float = 0.0,
+              raise_on_timeout: bool = False) -> bool:
     return True
 
   def init_randomly(self) -> None:

@@ -67,12 +67,14 @@ class LatencyHistogram:
 class _ClassStats:
   """Per-SLO-class counters (guarded by the owning ServingStats lock)."""
 
-  __slots__ = ("requests", "shed_expired", "shed_capacity", "latency")
+  __slots__ = ("requests", "shed_expired", "shed_capacity", "shed_fault",
+               "latency")
 
   def __init__(self):
     self.requests = 0
     self.shed_expired = 0
     self.shed_capacity = 0
+    self.shed_fault = 0
     self.latency = LatencyHistogram()
 
 
@@ -124,15 +126,19 @@ class ServingStats:
 
   def record_shed(self, class_name: Optional[str], reason: str) -> None:
     """One shed request: reason is "expired" (deadline already past at
-    enqueue) or "capacity" (queue bound exceeded, lowest-priority
-    victim). Sheds are counted on top of record_request — a shed
-    request was offered load too."""
+    enqueue), "capacity" (queue bound exceeded, lowest-priority
+    victim), or "fault" (a replica dispatch failed and the remaining
+    deadline slack could not cover a retry — ISSUE 14). Sheds are
+    counted on top of record_request — a shed request was offered load
+    too."""
     with self._lock:
       cls = self._class(class_name or "default")
       if reason == "expired":
         cls.shed_expired += 1
       elif reason == "capacity":
         cls.shed_capacity += 1
+      elif reason == "fault":
+        cls.shed_fault += 1
       else:
         raise ValueError(f"unknown shed reason {reason!r}")
     self._registry.counter(f"serving/shed_{reason}").inc()
@@ -198,12 +204,13 @@ class ServingStats:
 
   @staticmethod
   def _class_snapshot(cls: _ClassStats) -> Dict[str, float]:
-    shed = cls.shed_expired + cls.shed_capacity
+    shed = cls.shed_expired + cls.shed_capacity + cls.shed_fault
     entry = {
         "requests": cls.requests,
         "shed": shed,
         "shed_expired": cls.shed_expired,
         "shed_capacity": cls.shed_capacity,
+        "shed_fault": cls.shed_fault,
         "shed_rate": round(shed / cls.requests, 4) if cls.requests else 0.0,
     }
     for key, value in cls.latency.summary().items():

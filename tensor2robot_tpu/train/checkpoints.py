@@ -167,6 +167,187 @@ class CheckpointManager:
     self._manager.close()
 
 
+# -- loop sidecars + resume validation (ISSUE 14) ---------------------------
+#
+# The replay loop's crash-resume checkpoints pair an orbax TrainState
+# step dir with a SIDECAR directory holding everything orbax doesn't
+# own: the lagged target net, the replay ring's full state, rng
+# counters, eval history. The sidecar is written tmp→mv (the same
+# atomicity convention as async export), AFTER the orbax save
+# finalizes, so "sidecar present" implies "whole checkpoint usable" —
+# and a crash mid-save leaves at most an orphaned orbax step that
+# validation rejects, never a half-checkpoint a resume would load.
+
+SIDECAR_PREFIX = "sidecar-"
+SIDECAR_META = "meta.json"
+
+
+def sidecar_dir(root: str, step: int) -> str:
+  return os.path.join(os.path.abspath(root), f"{SIDECAR_PREFIX}{step}")
+
+
+def save_sidecar(root: str, step: int, trees=None, flats=None,
+                 meta: Optional[dict] = None) -> str:
+  """Writes the sidecar for `step` atomically (tmp dir → os.replace).
+
+  Args:
+    root: the checkpoint root (the CheckpointManager's directory).
+    step: the optimizer step (must match the orbax save).
+    trees: {name: nested {str: np.ndarray} tree} — each entry lands as
+      `<name>.npz` via export.variables_io (dtype-faithful,
+      bfloat16-safe; keys must not contain "/"). The target net goes
+      here.
+    flats: {name: FLAT {str: np.ndarray}} — each entry lands as a
+      plain np.savez `<name>.npz` with the keys verbatim (slashes
+      allowed; native numpy dtypes only). The replay ring's
+      `storage/<leaf>` state goes here.
+    meta: JSON-able dict; written as meta.json with the npz manifests
+      recorded under "_trees"/"_flats" so load/validate know the
+      expected contents.
+  """
+  import json as json_lib
+  import shutil
+
+  from tensor2robot_tpu.export import variables_io
+
+  trees = trees or {}
+  flats = flats or {}
+  overlap = set(trees) & set(flats)
+  if overlap:
+    raise ValueError(f"sidecar entry names collide: {sorted(overlap)}")
+  final = sidecar_dir(root, step)
+  tmp = final + ".tmp"
+  if os.path.isdir(tmp):
+    shutil.rmtree(tmp)
+  os.makedirs(tmp, exist_ok=True)
+  for name, tree in trees.items():
+    variables_io.save_variables(os.path.join(tmp, f"{name}.npz"), tree)
+  for name, flat in flats.items():
+    with open(os.path.join(tmp, f"{name}.npz"), "wb") as f:
+      np.savez(f, **{key: np.asarray(value)
+                     for key, value in flat.items()})
+  meta = dict(meta or {})
+  meta["_trees"] = sorted(trees.keys())
+  meta["_flats"] = sorted(flats.keys())
+  meta["step"] = int(step)
+  with open(os.path.join(tmp, SIDECAR_META), "w") as f:
+    json_lib.dump(meta, f)
+  if os.path.isdir(final):
+    shutil.rmtree(final)
+  os.replace(tmp, final)
+  return final
+
+
+def load_sidecar(root: str, step: int):
+  """(trees, flats, meta) for `step`; raises with the defect named when
+  the sidecar is missing or damaged (the resume path converts that
+  into a rejected-checkpoint flightrec record and tries an older
+  step). Every npz entry is fully read — a truncated partial write
+  fails its zip CRC here, never inside training."""
+  import json as json_lib
+
+  from tensor2robot_tpu.export import variables_io
+
+  directory = sidecar_dir(root, step)
+  meta_path = os.path.join(directory, SIDECAR_META)
+  if not os.path.isfile(meta_path):
+    raise FileNotFoundError(f"sidecar meta missing at {meta_path}")
+  with open(meta_path) as f:
+    meta = json_lib.load(f)
+  trees = {name: variables_io.load_variables(
+      os.path.join(directory, f"{name}.npz"))
+      for name in meta.get("_trees", [])}
+  flats = {}
+  for name in meta.get("_flats", []):
+    with np.load(os.path.join(directory, f"{name}.npz")) as data:
+      flats[name] = {key: data[key] for key in data.files}
+  return trees, flats, meta
+
+
+def validate_checkpoint_dir(root: str, step: int,
+                            require_sidecar: bool = True):
+  """(ok, reason): is (orbax step dir + sidecar) a complete, finalized,
+  loadable checkpoint? Structural only — no restore is attempted:
+  the orbax dir must exist with finalized content (no
+  orbax-checkpoint-tmp markers anywhere in its tree's first level),
+  and the sidecar's meta must parse and every npz it names must read
+  back (zip CRC — a truncated partial write fails HERE, not as a
+  corrupted tree mid-training). Shared by the replay loop's resume
+  scan and the chaos bench's corrupt-checkpoint rejection bar."""
+  root = os.path.abspath(root)
+  step_dir = os.path.join(root, str(step))
+  if not os.path.isdir(step_dir):
+    return False, f"orbax step dir missing: {step_dir}"
+  entries = os.listdir(step_dir)
+  if not entries:
+    return False, f"orbax step dir empty: {step_dir}"
+  tmp = [e for e in entries if "orbax-checkpoint-tmp" in e]
+  if tmp:
+    return False, f"orbax step dir mid-write (tmp markers): {tmp}"
+  if not require_sidecar:
+    return True, "ok"
+  directory = sidecar_dir(root, step)
+  if not os.path.isdir(directory):
+    return False, f"sidecar missing: {directory}"
+  try:
+    trees, flats, meta = load_sidecar(root, step)
+    del trees, flats
+    if int(meta.get("step", -1)) != int(step):
+      return False, (f"sidecar step {meta.get('step')} != dir step "
+                     f"{step}")
+  except Exception as e:
+    return False, f"sidecar unreadable: {type(e).__name__}: {e}"
+  return True, "ok"
+
+
+def list_checkpoint_steps(root: str):
+  """Numeric step dirs under `root`, ascending (no orbax manager
+  needed — the resume scan must work on a directory another process
+  wrote)."""
+  root = os.path.abspath(root)
+  if not os.path.isdir(root):
+    return []
+  return sorted(int(e) for e in os.listdir(root)
+                if e.isdigit() and os.path.isdir(os.path.join(root, e)))
+
+
+def latest_resumable_step(root: str, recorder=None):
+  """Newest step under `root` that validates end-to-end; None when no
+  step survives. Every REJECTED newer step is recorded (flight
+  recorder reason ``checkpoint_rejected``) — a resume that silently
+  skipped a corrupt newest checkpoint must leave evidence of it."""
+  for step in reversed(list_checkpoint_steps(root)):
+    ok, reason = validate_checkpoint_dir(root, step)
+    if ok:
+      return step
+    if recorder is not None:
+      try:
+        # `detail`, not `reason`: the recorder's positional `reason`
+        # IS the trigger name.
+        recorder.trigger("checkpoint_rejected", step=int(step),
+                         detail=reason, root=root)
+      except Exception:
+        pass
+  return None
+
+
+def prune_sidecars(root: str, keep_steps) -> None:
+  """Removes sidecars whose orbax step was garbage-collected (the
+  manager's max_to_keep owns step retention; sidecars follow it)."""
+  import shutil
+
+  root = os.path.abspath(root)
+  if not os.path.isdir(root):
+    return
+  keep = {int(s) for s in keep_steps}
+  for entry in os.listdir(root):
+    if not entry.startswith(SIDECAR_PREFIX):
+      continue
+    suffix = entry[len(SIDECAR_PREFIX):].split(".")[0]
+    if suffix.isdigit() and int(suffix) not in keep:
+      shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
+
+
 def restore_params(checkpoint_path: str) -> Any:
   """Loads just the `params` subtree from a run directory or step dir.
 

@@ -1,0 +1,119 @@
+"""Jittered exponential backoff for filesystem/export polling loops.
+
+Every "wait for an artifact to appear" loop in the repo — a predictor's
+``restore(timeout_s)`` watching an export root, the replay loop's
+min-fill gate, a resume path waiting out a mid-write checkpoint — used
+to poll at one fixed cadence. That is the wrong shape twice over: a
+fleet of robots restarting together hammers the export filesystem in
+lockstep (the thundering-herd the jitter breaks up), and a fixed short
+interval burns CPU exactly when the wait is long (the case backoff
+exists for). This module is the ONE shared poll engine:
+
+- **exponential**: intervals grow ``initial_s * factor^k`` up to
+  ``max_s`` — cheap when the artifact lands fast, polite when it
+  doesn't;
+- **jittered**: each interval is scaled by a seeded uniform draw in
+  ``[1 - jitter, 1 + jitter]`` so co-started pollers decorrelate (the
+  rng is per-call and seedable, so tests pin the exact schedule);
+- **deadline-exact**: the final sleep is clamped to the remaining
+  budget — a poller never overshoots its timeout by a whole interval;
+- **accountable**: on timeout the caller either gets the predicate's
+  falsy value back (the predictors' bool contract) or a ``PollTimeout``
+  that NAMES what was being waited on and for how long — "restore
+  timed out" with no path is the error message this class of bug
+  reports always lacked.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+
+class PollTimeout(TimeoutError):
+  """A poll loop exhausted its budget; names the awaited target.
+
+  Attributes:
+    description: what was being waited on (a path, an export root, a
+      buffer gate) — the actionable half of the message.
+    waited_s: how long the loop actually waited.
+    attempts: how many predicate evaluations ran.
+  """
+
+  def __init__(self, description: str, waited_s: float, attempts: int):
+    self.description = description
+    self.waited_s = waited_s
+    self.attempts = attempts
+    polls = f" ({attempts} polls)" if attempts > 0 else ""
+    super().__init__(
+        f"timed out after {waited_s:.2f}s{polls} waiting "
+        f"for {description}")
+
+
+def backoff_intervals(initial_s: float = 0.05, max_s: float = 2.0,
+                      factor: float = 2.0, jitter: float = 0.25,
+                      seed: Optional[int] = None) -> Iterator[float]:
+  """Infinite stream of jittered exponential sleep intervals.
+
+  The deterministic core ``poll_with_backoff`` consumes: interval k is
+  ``min(initial_s * factor**k, max_s)`` scaled by a uniform draw in
+  ``[1 - jitter, 1 + jitter]``. A seeded call yields the exact same
+  schedule every time (the fault/bench determinism contract); an
+  unseeded call uses fresh OS entropy so co-started production pollers
+  decorrelate.
+  """
+  if initial_s <= 0:
+    raise ValueError(f"initial_s must be > 0, got {initial_s}")
+  if factor < 1.0:
+    raise ValueError(f"factor must be >= 1, got {factor}")
+  if not 0.0 <= jitter < 1.0:
+    raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+  rng = np.random.default_rng(seed)
+  interval = float(initial_s)
+  while True:
+    scale = 1.0 + jitter * (2.0 * float(rng.random()) - 1.0)
+    yield min(interval, max_s) * scale
+    interval = min(interval * factor, max_s)
+
+
+def poll_with_backoff(predicate: Callable[[], object],
+                      timeout_s: float,
+                      initial_s: float = 0.05,
+                      max_s: float = 2.0,
+                      factor: float = 2.0,
+                      jitter: float = 0.25,
+                      seed: Optional[int] = None,
+                      description: Optional[str] = None,
+                      raise_on_timeout: bool = False):
+  """Polls ``predicate()`` with jittered exponential backoff.
+
+  Returns the predicate's first truthy value. On timeout, returns the
+  last (falsy) value — the predictors' ``restore() -> bool`` contract —
+  unless ``raise_on_timeout`` is set, in which case a ``PollTimeout``
+  naming ``description`` is raised (the replay loop's min-fill gate and
+  the resume path want the loud form: a robot that silently proceeds
+  without a model is worse than one that crashes with the path it was
+  waiting on).
+
+  The predicate is always evaluated at least once (timeout_s=0 is the
+  non-blocking probe every restore() supports), and the final sleep is
+  clamped so the loop never waits past its deadline.
+  """
+  deadline = time.monotonic() + max(0.0, timeout_s)
+  intervals = backoff_intervals(initial_s, max_s, factor, jitter, seed)
+  attempts = 0
+  started = time.monotonic()
+  while True:
+    value = predicate()
+    attempts += 1
+    if value:
+      return value
+    remaining = deadline - time.monotonic()
+    if remaining <= 0:
+      if raise_on_timeout:
+        raise PollTimeout(description or "<unnamed condition>",
+                          time.monotonic() - started, attempts)
+      return value
+    time.sleep(min(next(intervals), remaining))

@@ -1,0 +1,739 @@
+"""Fault-tolerant fleet (ISSUE 14): injection, self-healing, resume.
+
+Tier-1 contracts for the fault-tolerance layer: the FaultPlan's
+schedules are deterministic (same plan + same call sequence ⇒ the same
+faults, every run) and every fired fault carries the active
+correlation id; the circuit breaker walks closed→open→half-open→closed
+exactly (driven with injected clocks — no sleeps in the state-machine
+tests); the router's deadline-aware retry re-routes when slack allows
+and resolves a TYPED ``RequestShed(class, "fault")`` when it doesn't;
+degraded mode (whole fleet quarantined) sheds lowest-priority-first on
+the existing SLO machinery instead of erroring; a killed dispatcher
+restarts inside its budget and resolves every pending future typed
+past it (clients never hang); corrupt/partial exports are rejected
+with flight-recorder records and never swapped in; and learner
+crash-resume reproduces the uninterrupted run BIT FOR BIT on the
+deterministic pre-training stream.
+
+Timing-bar convention: quantitative bars (post-chaos p99, live-loop TD
+deltas) gate on >= 4 cores per the repo's flaky-under-contention rule;
+structure asserts everywhere. The committed FAULTS_r15.json carries
+the full-protocol numbers and is schema+bar-validated here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+QUANT = (os.cpu_count() or 1) >= 4
+
+
+# -- fault plan -------------------------------------------------------------
+
+
+class TestFaultPlan:
+  """Determinism + the correlation-id stamp contract."""
+
+  def test_unknown_kind_and_missing_schedule_rejected(self):
+    from tensor2robot_tpu.obs import faults
+    with pytest.raises(ValueError, match="unknown fault kind"):
+      faults.FaultSpec(kind="segfault", point="replica_dispatch", at=0)
+    with pytest.raises(ValueError, match="no schedule"):
+      faults.FaultSpec(kind="dispatch_error", point="replica_dispatch")
+
+  def test_at_every_count_schedule_is_deterministic(self):
+    from tensor2robot_tpu.obs import faults
+
+    def drive(plan):
+      fired = []
+      for tick in range(12):
+        fired.append(bool(plan.check("p", site="s")))
+      return fired
+
+    make = lambda: faults.FaultPlan([
+        faults.FaultSpec(kind="dispatch_error", point="p", site="s",
+                         at=2, every=3, count=3)], seed=7)
+    first, second = drive(make()), drive(make())
+    assert first == second
+    # at=2, every=3, count=3 -> ticks 2, 5, 8 and nothing after.
+    assert [i for i, fired in enumerate(first) if fired] == [2, 5, 8]
+
+  def test_probability_schedule_is_seed_deterministic(self):
+    from tensor2robot_tpu.obs import faults
+
+    def drive(seed):
+      plan = faults.FaultPlan([
+          faults.FaultSpec(kind="dispatch_error", point="p",
+                           probability=0.5, count=100)], seed=seed)
+      return [bool(plan.check("p")) for _ in range(40)]
+
+    assert drive(3) == drive(3)
+    assert drive(3) != drive(4)  # different seed, different draws
+
+  def test_site_isolation_and_explicit_index(self):
+    from tensor2robot_tpu.obs import faults
+    plan = faults.FaultPlan([
+        faults.FaultSpec(kind="crash", point="learner_step",
+                         site="learner", at=5)], seed=0)
+    # Other sites never match; the explicit index (optimizer step)
+    # drives the schedule, not the call counter.
+    assert plan.check("learner_step", site="other", index=5) == []
+    assert plan.check("learner_step", site="learner", index=4) == []
+    with pytest.raises(faults.InjectedCrash) as info:
+      plan.perturb("learner_step", site="learner", index=5)
+    assert info.value.step == 5
+    # count=1: exhausted.
+    assert plan.check("learner_step", site="learner", index=5) == []
+
+  def test_fired_fault_carries_bound_correlation_id(self):
+    from tensor2robot_tpu.obs import context as context_lib
+    from tensor2robot_tpu.obs import faults
+    from tensor2robot_tpu.obs.flight_recorder import FlightRecorder
+    recorder = FlightRecorder()
+    plan = faults.FaultPlan([
+        faults.FaultSpec(kind="latency_spike", point="replica_dispatch",
+                         at=0, latency_s=0.0)], seed=0,
+        recorder=recorder)
+    with context_lib.bind(request_ids="req-a,req-b"):
+      plan.perturb("replica_dispatch", site="dev0")
+    assert plan.fired[0]["request_ids"] == "req-a,req-b"
+    triggers = [e for e in recorder.events()
+                if e.get("name") == "fault_injected"]
+    assert triggers and triggers[0]["request_ids"] == "req-a,req-b"
+
+  def test_kill_is_not_an_exception_and_error_is(self):
+    from tensor2robot_tpu.obs import faults
+    assert not issubclass(faults.InjectedKill, Exception)
+    assert issubclass(faults.InjectedKill, BaseException)
+    assert issubclass(faults.InjectedFault, RuntimeError)
+
+  def test_damage_export_partial_and_corrupt(self, tmp_path):
+    import numpy as _np
+
+    from tensor2robot_tpu.export import variables_io
+    from tensor2robot_tpu.export.native_export_generator import (
+        VARIABLES_NPZ)
+    from tensor2robot_tpu.obs import faults
+    export_dir = tmp_path / "1"
+    export_dir.mkdir()
+    path = str(export_dir / VARIABLES_NPZ)
+    variables_io.save_variables(
+        path, {"w": _np.zeros((4,), _np.float32)})
+    full = os.path.getsize(path)
+    faults.damage_export(str(export_dir), "export_partial_write")
+    assert os.path.getsize(path) == max(1, full // 2)
+    faults.damage_export(str(export_dir), "export_corrupt")
+    with pytest.raises(Exception):
+      variables_io.load_variables(path)
+
+
+# -- circuit breaker --------------------------------------------------------
+
+
+class TestCircuitBreaker:
+  """The open/half-open/close state machine with injected clocks."""
+
+  def test_opens_at_threshold_consecutive_failures_only(self):
+    from tensor2robot_tpu.serving.slo import CircuitBreaker
+    breaker = CircuitBreaker(failure_threshold=3, quarantine_s=5.0)
+    breaker.record_failure(now=0.0)
+    breaker.record_failure(now=0.1)
+    breaker.record_success(now=0.2)  # resets the consecutive count
+    breaker.record_failure(now=0.3)
+    breaker.record_failure(now=0.4)
+    assert breaker.state == "closed"
+    breaker.record_failure(now=0.5)
+    assert breaker.state == "open"
+
+  def test_quarantine_blocks_then_one_probe_then_close(self):
+    from tensor2robot_tpu.serving.slo import CircuitBreaker
+    breaker = CircuitBreaker(failure_threshold=1, quarantine_s=5.0)
+    breaker.record_failure(now=0.0)
+    assert breaker.state == "open"
+    assert breaker.allows(now=1.0) is False   # still quarantined
+    assert breaker.allows(now=5.0) is True    # claims THE probe
+    assert breaker.state == "half_open"
+    assert breaker.allows(now=5.1) is False   # one probe at a time
+    breaker.record_success(now=5.2)
+    assert breaker.state == "closed"
+    assert breaker.allows(now=5.3) is True
+
+  def test_probe_failure_requarantines_for_fresh_window(self):
+    from tensor2robot_tpu.serving.slo import CircuitBreaker
+    breaker = CircuitBreaker(failure_threshold=1, quarantine_s=5.0)
+    breaker.record_failure(now=0.0)
+    assert breaker.allows(now=5.0) is True
+    breaker.record_failure(now=5.5)           # the probe failed
+    assert breaker.state == "open"
+    assert breaker.allows(now=9.0) is False   # window restarted at 5.5
+    assert breaker.allows(now=10.5) is True
+
+  def test_shed_probe_releases_slot_without_verdict(self):
+    from tensor2robot_tpu.serving.slo import CircuitBreaker
+    breaker = CircuitBreaker(failure_threshold=1, quarantine_s=5.0)
+    breaker.record_failure(now=0.0)
+    assert breaker.allows(now=5.0) is True
+    breaker.release_probe()                   # probe was shed, no verdict
+    assert breaker.state == "half_open"
+    assert breaker.allows(now=5.1) is True    # next request may probe
+
+  def test_transition_history_recorded(self):
+    from tensor2robot_tpu.serving.slo import CircuitBreaker
+    breaker = CircuitBreaker(failure_threshold=1, quarantine_s=1.0)
+    breaker.record_failure(now=0.0)
+    breaker.allows(now=1.0)
+    breaker.record_success(now=1.1)
+    assert [e["state"] for e in breaker.events] == [
+        "open", "half_open", "closed"]
+
+
+# -- router self-healing ----------------------------------------------------
+
+
+def _make_router(devices, plan, **health_kwargs):
+  from tensor2robot_tpu.serving.router import FleetRouter
+  from tensor2robot_tpu.serving.slo import HealthConfig
+  from tensor2robot_tpu.serving.smoke import TinyQPredictor
+  predictor = TinyQPredictor(seed=0)
+  router = FleetRouter(
+      predictor, devices=devices, ladder_sizes=(1, 2), max_queue=16,
+      dispatch_margin_ms=1500.0, seed=0,
+      health=HealthConfig(**health_kwargs), fault_plan=plan)
+  router.warmup(predictor.make_image)
+  return router, predictor
+
+
+class TestRouterSelfHealing:
+  """Quarantine, probes, deadline-aware retry, degraded shedding."""
+
+  def test_retry_reroutes_and_quarantine_probe_reinstate(self):
+    import jax
+
+    from tensor2robot_tpu.obs import faults
+    from tensor2robot_tpu.serving.slo import SLOClass
+    devices = jax.devices()[:2]
+    plan = faults.FaultPlan([
+        faults.FaultSpec(kind="dispatch_error", point="replica_dispatch",
+                         site=str(devices[0]), at=0, every=1, count=2)],
+        seed=0)
+    router, predictor = _make_router(
+        devices, plan, failure_threshold=2, quarantine_s=0.3,
+        retry_cost_ms=5.0, max_retries=2)
+    slo = SLOClass("interactive", priority=2, deadline_ms=2000.0)
+    image = predictor.make_image(1)
+    with router:
+      # Every request resolves with a RESULT: the failed dispatches
+      # are absorbed by retries onto the healthy replica.
+      for _ in range(6):
+        action = router.act(image, slo=slo, timeout=30.0)
+        assert np.all(np.isfinite(np.asarray(action)))
+      deadline = time.monotonic() + 30.0
+      while time.monotonic() < deadline:
+        events = [e["event"]
+                  for e in router.health_snapshot()["timeline"]]
+        if "reinstate" in events:
+          break
+        router.act(image, slo=slo, timeout=30.0)
+      snapshot = router.health_snapshot()
+    events = [e["event"] for e in snapshot["timeline"]]
+    assert "retry" in events
+    assert "quarantine" in events
+    assert "probe" in events
+    assert "reinstate" in events
+    assert snapshot["replicas"][str(devices[0])]["state"] == "closed"
+    assert plan.fired_counts()["dispatch_error"] == 2
+
+  def test_no_slack_or_no_replica_sheds_typed_fault(self):
+    import jax
+
+    from tensor2robot_tpu.obs import faults
+    from tensor2robot_tpu.serving.slo import RequestShed, SLOClass
+    devices = jax.devices()[:1]
+    plan = faults.FaultPlan([
+        faults.FaultSpec(kind="dispatch_error", point="replica_dispatch",
+                         at=0, every=1, count=100)], seed=0)
+    router, predictor = _make_router(
+        devices, plan, failure_threshold=2, quarantine_s=30.0,
+        retry_cost_ms=5.0, max_retries=2)
+    slo = SLOClass("interactive", priority=2, deadline_ms=2000.0)
+    with router:
+      future = router.submit(predictor.make_image(1), slo=slo)
+      with pytest.raises(RequestShed) as info:
+        future.result(30.0)
+    assert info.value.reason == "fault"
+    assert info.value.class_name == "interactive"
+    snap = router.stats.snapshot()["per_class"]["interactive"]
+    assert snap["shed_fault"] == 1
+    assert snap["shed"] == 1
+
+  def test_degraded_mode_serves_and_sheds_by_priority(self):
+    """The bench's degraded phase at tier-1 scale: fleet fully
+    quarantined -> typed sheds, then the held-flush burst sheds
+    lowest-priority-first while still COMPLETING admitted work."""
+    import jax
+
+    from tensor2robot_tpu.serving.fault_bench import (R15_CLASSES,
+                                                      _measure_degraded)
+    classes = tuple((slo_class, max(2, clients // 4), hz)
+                    for slo_class, clients, hz in R15_CLASSES)
+    block = _measure_degraded(jax.devices()[:2], classes, seed=0)
+    assert block["raw_errors"] == 0
+    assert block["typed_sheds"] > 0
+    assert block["shed_fault_total_phase"] > 0
+    assert block["degraded_entered"] is True
+    assert block["all_replicas_open"] is True
+    assert block["burst"]["priority_ordering_ok"] is True
+    assert block["burst_completed"] > 0
+
+  def test_no_fault_plan_is_the_oracle(self):
+    """No plan installed: dispatch succeeds, breakers never move, the
+    health timeline stays empty, ledger exactly-once per bucket."""
+    import jax
+    router, predictor = _make_router(
+        jax.devices()[:2], None, failure_threshold=3, quarantine_s=1.0)
+    with router:
+      for i in range(4):
+        router.act(predictor.make_image(i), timeout=30.0)
+      snapshot = router.health_snapshot()
+    assert snapshot["timeline"] == []
+    assert all(entry["state"] == "closed"
+               for entry in snapshot["replicas"].values())
+    assert snapshot["degraded"] is False
+    ledger = router.compile_ledger()
+    assert all(count == 1 for per_device in ledger.values()
+               for count in per_device.values())
+
+
+# -- dispatcher death -------------------------------------------------------
+
+
+class _PoisonError(BaseException):
+  """A non-Exception escaping batch_fn — the poison-request shape the
+  per-flush `except Exception` recovery CANNOT absorb."""
+
+
+class TestDispatcherDeath:
+  """The MicroBatcher satellite: clients never hang on a dead
+  dispatcher — regression test with an injected poison request."""
+
+  def test_poison_request_kills_then_restart_serves(self):
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+    from tensor2robot_tpu.serving.slo import DispatcherDead
+
+    def batch_fn(items):
+      if any(item == "poison" for item in items):
+        raise _PoisonError("poison request")
+      return [f"ok:{item}" for item in items]
+
+    batcher = MicroBatcher(batch_fn, max_batch=4, deadline_ms=30.0,
+                           restart_budget=1)
+    with batcher:
+      assert batcher.submit("a").result(10.0) == "ok:a"
+      poisoned = batcher.submit("poison")
+      with pytest.raises(DispatcherDead):
+        poisoned.result(10.0)
+      deadline = time.monotonic() + 10.0
+      while (batcher.dispatcher_restarts < 1
+             and time.monotonic() < deadline):
+        time.sleep(0.01)
+      assert batcher.dispatcher_restarts == 1
+      assert batcher.submit("b").result(10.0) == "ok:b"
+      assert not batcher.dispatcher_dead
+
+  def test_budget_exhausted_resolves_every_pending_future_typed(self):
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+    from tensor2robot_tpu.serving.slo import DispatcherDead
+
+    def batch_fn(items):
+      if any(item == "poison" for item in items):
+        raise _PoisonError("poison request")
+      return list(items)
+
+    batcher = MicroBatcher(batch_fn, max_batch=2, deadline_ms=50.0,
+                           restart_budget=0)
+    batcher.start()
+    with batcher.hold_flushes():
+      # The poison pair flushes first (max_batch 2); the rest are
+      # QUEUED when the dispatcher dies and must resolve typed too.
+      futures = [batcher.submit("poison"), batcher.submit("x")]
+      futures += [batcher.submit(i) for i in range(4)]
+    for future in futures:
+      with pytest.raises(DispatcherDead):
+        future.result(10.0)
+    deadline = time.monotonic() + 10.0
+    while not batcher.dispatcher_dead and time.monotonic() < deadline:
+      time.sleep(0.01)
+    assert batcher.dispatcher_dead
+    with pytest.raises(DispatcherDead):
+      batcher.submit("late")
+    batcher.stop()  # clean shutdown on a dead batcher: no hang/raise
+
+  def test_ordinary_flush_exception_still_recovers_in_place(self):
+    """The pre-existing contract stands: an Exception fails only its
+    flush, no restart consumed, no death."""
+    from tensor2robot_tpu.serving.batcher import MicroBatcher
+
+    calls = []
+
+    def batch_fn(items):
+      calls.append(list(items))
+      if len(calls) == 1:
+        raise ValueError("transient")
+      return list(items)
+
+    batcher = MicroBatcher(batch_fn, max_batch=1, deadline_ms=20.0,
+                           restart_budget=1)
+    with batcher:
+      with pytest.raises(ValueError):
+        batcher.submit("a").result(10.0)
+      assert batcher.submit("b").result(10.0) == "b"
+    assert batcher.dispatcher_restarts == 0
+    assert not batcher.dispatcher_dead
+
+
+# -- transition queue under producer death ----------------------------------
+
+
+class TestQueueUnderProducerDeath:
+  """The TransitionQueue satellite: dying producers + concurrent
+  drains never deadlock, and row accounting stays EXACT."""
+
+  @staticmethod
+  def _chunk(n, value=0.0):
+    return {
+        "image": np.full((n, 4, 4, 3), value, np.uint8),
+        "action": np.zeros((n, 2), np.float32),
+        "reward": np.zeros((n,), np.float32),
+        "done": np.zeros((n,), np.float32),
+        "next_image": np.zeros((n, 4, 4, 3), np.uint8),
+    }
+
+  def test_producer_death_mid_stream_accounting_exact(self):
+    from tensor2robot_tpu.replay.ingest import TransitionQueue
+    queue = TransitionQueue(64)
+    puts_done = []
+
+    def producer(worker, dies_after):
+      count = 0
+      try:
+        for i in range(50):
+          if i == dies_after:
+            raise _PoisonError("producer died")
+          queue.put_batch(self._chunk(3))
+          count += 3
+      except BaseException:
+        pass  # the thread dies; the queue must not care
+      finally:
+        puts_done.append(count)
+
+    stop = threading.Event()
+    drained = [0]
+
+    def consumer():
+      while not stop.is_set():
+        batch = queue.drain_batch(max_items=16)
+        if batch is not None:
+          drained[0] += next(iter(batch.values())).shape[0]
+        else:
+          time.sleep(0.001)
+
+    threads = [threading.Thread(target=producer, args=(w, d))
+               for w, d in ((0, 7), (1, 23), (2, 50))]
+    consumer_thread = threading.Thread(target=consumer)
+    consumer_thread.start()
+    for thread in threads:
+      thread.start()
+    for thread in threads:
+      thread.join(30.0)
+      assert not thread.is_alive()
+    # Final drain, then the ledger must balance to the row.
+    stop.set()
+    consumer_thread.join(30.0)
+    assert not consumer_thread.is_alive()
+    tail = queue.drain_batch()
+    if tail is not None:
+      drained[0] += next(iter(tail.values())).shape[0]
+    stats = queue.stats()
+    assert stats["enqueued"] == sum(puts_done)
+    assert stats["pending"] == 0
+    assert stats["enqueued"] == stats["dequeued"] + stats["dropped"]
+    assert drained[0] == stats["dequeued"]
+
+  def test_consumer_blocked_in_drain_while_producers_stop(self):
+    """drain_batch under concurrent put_batch + producer stop: the
+    lock is only ever held for slicing, so no interleaving deadlocks;
+    drop accounting stays exact through overflow."""
+    from tensor2robot_tpu.replay.ingest import TransitionQueue
+    queue = TransitionQueue(16)  # tiny: force drop-oldest constantly
+    stop = threading.Event()
+
+    def producer():
+      while not stop.is_set():
+        queue.put_batch(self._chunk(5))
+
+    producers = [threading.Thread(target=producer) for _ in range(2)]
+    for thread in producers:
+      thread.start()
+    drained = 0
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+      batch = queue.drain_batch(max_items=7)
+      if batch is not None:
+        drained += next(iter(batch.values())).shape[0]
+    stop.set()  # producers die with the queue mid-traffic
+    for thread in producers:
+      thread.join(30.0)
+      assert not thread.is_alive()
+    tail = queue.drain_batch()
+    if tail is not None:
+      drained += next(iter(tail.values())).shape[0]
+    stats = queue.stats()
+    assert stats["pending"] == 0
+    assert stats["enqueued"] == stats["dequeued"] + stats["dropped"]
+    assert drained == stats["dequeued"]
+
+  def test_restore_counters_keeps_ledger_monotonic(self):
+    from tensor2robot_tpu.replay.ingest import TransitionQueue
+    queue = TransitionQueue(64)
+    queue.put_batch(self._chunk(4))
+    queue.drain_batch()
+    saved = {k: v for k, v in queue.stats().items() if k != "pending"}
+    fresh = TransitionQueue(64)
+    fresh.restore_counters(**saved)
+    assert {k: v for k, v in fresh.stats().items()
+            if k != "pending"} == saved
+
+
+# -- export watcher validation ----------------------------------------------
+
+
+class TestExportValidation:
+  """Corrupt/partial exports rejected with flightrec records, never
+  swapped in; mid-publish tmp markers rejected too."""
+
+  def test_damaged_exports_rejected_goods_accepted(self):
+    from tensor2robot_tpu.serving.fault_bench import (
+        _measure_export_watcher)
+    block = _measure_export_watcher(seed=0)
+    assert block["accepted"] == [1, 3, 5]
+    assert block["rejected_versions"] == [2, 4]
+    assert block["rejection_dumps"] >= 1
+    assert block["ok"] is True
+
+  def test_tmp_marker_dir_rejected(self, tmp_path):
+    from tensor2robot_tpu.serving.rollout import ExportWatcher
+    export_dir = tmp_path / "5"
+    export_dir.mkdir()
+    (export_dir / "variables.npz.orbax-checkpoint-tmp-1").write_bytes(
+        b"x")
+    watcher = ExportWatcher(str(tmp_path))
+    assert watcher.poll() is None
+    assert watcher.rejections
+    assert "tmp" in watcher.rejections[0]["reason"]
+
+  def test_validate_checkpoint_dir_rejects_damage(self, tmp_path):
+    """The resume-side validation: missing orbax dir, missing sidecar,
+    truncated sidecar npz — each rejected with the defect named."""
+    from tensor2robot_tpu.train import checkpoints as checkpoints_lib
+    root = str(tmp_path)
+    ok, reason = checkpoints_lib.validate_checkpoint_dir(root, 10)
+    assert not ok and "missing" in reason
+    step_dir = tmp_path / "10" / "default"
+    step_dir.mkdir(parents=True)
+    (step_dir / "x").write_bytes(b"x")
+    ok, reason = checkpoints_lib.validate_checkpoint_dir(root, 10)
+    assert not ok and "sidecar missing" in reason
+    checkpoints_lib.save_sidecar(
+        root, 10, trees={"target": {"w": np.zeros(3, np.float32)}},
+        flats={"buffer": {"storage/image": np.zeros(4, np.uint8)}},
+        meta={"x": 1})
+    ok, reason = checkpoints_lib.validate_checkpoint_dir(root, 10)
+    assert ok, reason
+    # Truncate one npz: validation must fail its CRC read.
+    npz = checkpoints_lib.sidecar_dir(root, 10) + "/buffer.npz"
+    size = os.path.getsize(npz)
+    with open(npz, "rb+") as f:
+      f.truncate(size // 2)
+    ok, reason = checkpoints_lib.validate_checkpoint_dir(root, 10)
+    assert not ok and "unreadable" in reason
+    assert checkpoints_lib.latest_resumable_step(root) is None
+
+
+# -- learner crash-resume ---------------------------------------------------
+
+
+class TestLearnerResume:
+  """Resume TD-parity (bit-exact on the deterministic stream) + the
+  live loop's crash/resume plumbing."""
+
+  def test_resume_parity_bit_exact(self):
+    from tensor2robot_tpu.serving.fault_bench import (
+        _measure_resume_parity)
+    parity = _measure_resume_parity(6, 6, seed=0)
+    assert parity["restored_step"] == 6
+    assert parity["buffer_bit_equal"] is True
+    assert parity["pre_crash_stream_bit_equal"] is True
+    assert parity["post_resume_stream_bit_equal"] is True
+    assert parity["max_post_resume_td_delta"] == 0.0
+    assert parity["parity_ok"] is True
+
+  def test_live_loop_crash_then_resume_continues_exact_step(
+      self, tmp_path):
+    """A real ReplayTrainLoop killed by an injected crash resumes from
+    its checkpoint: eval history continues (original step-0 baseline
+    kept), the run completes, TD bar gated on cores."""
+    import optax
+
+    from tensor2robot_tpu.obs import faults
+    from tensor2robot_tpu.replay.loop import (ReplayLoopConfig,
+                                              ReplayTrainLoop)
+    from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+    logdir = str(tmp_path)
+
+    def make_loop(resume=False, plan=None):
+      config = ReplayLoopConfig(
+          seed=0, checkpoint_every=10, resume=resume, eval_every=10,
+          mesh_dp=1, mesh_tp=1)
+      model = TinyQCriticModel(
+          image_size=config.image_size,
+          action_size=config.action_size,
+          optimizer_fn=lambda: optax.adam(config.learning_rate))
+      return ReplayTrainLoop(config, logdir, model=model,
+                             fault_plan=plan)
+
+    plan = faults.FaultPlan([
+        faults.FaultSpec(kind="crash", point="learner_step",
+                         site="learner", at=15)], seed=0)
+    with pytest.raises(faults.InjectedCrash) as info:
+      make_loop(plan=plan).run(30)
+    assert info.value.step == 15
+    result = make_loop(resume=True).run(30)
+    assert result["steps"] == 30
+    steps = [entry["step"] for entry in result["eval_history"]]
+    # Step 0 and 10 come from the INTERRUPTED run's history (restored
+    # from the checkpoint at 10); 20 and 30 from the resumed run.
+    assert steps == [0, 10, 20, 30]
+    assert all(v == 1 for v in result["compile_counts"].values()), (
+        result["compile_counts"])
+    if QUANT:
+      assert result["eval_td_reduction"] >= 0.30
+
+  def test_resume_with_empty_dir_starts_fresh(self, tmp_path):
+    """resume=True with no checkpoint on disk: fresh start, not an
+    error — the preemption-tolerant default."""
+    import optax
+
+    from tensor2robot_tpu.replay.loop import (ReplayLoopConfig,
+                                              ReplayTrainLoop)
+    from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+    config = ReplayLoopConfig(seed=0, resume=True, eval_every=10,
+                              mesh_dp=1, mesh_tp=1)
+    model = TinyQCriticModel(
+        image_size=config.image_size, action_size=config.action_size,
+        optimizer_fn=lambda: optax.adam(config.learning_rate))
+    result = ReplayTrainLoop(config, str(tmp_path), model=model).run(10)
+    assert result["steps"] == 10
+
+  def test_fused_paths_refuse_checkpointing(self, tmp_path):
+    from tensor2robot_tpu.replay.loop import (ReplayLoopConfig,
+                                              ReplayTrainLoop)
+    with pytest.raises(ValueError, match="host path"):
+      ReplayTrainLoop(
+          ReplayLoopConfig(anakin=True, checkpoint_every=10),
+          str(tmp_path))
+
+
+# -- CLI + committed artifact -----------------------------------------------
+
+
+class TestFaultBenchCLI:
+  """The --ci subprocess protocol: reduced scale, full structure."""
+
+  def test_ci_lane_subprocess(self):
+    res = subprocess.run(
+        [sys.executable, "-m", "tensor2robot_tpu.serving.fault_bench",
+         "--ci"],
+        capture_output=True, text=True, timeout=420, cwd=ROOT,
+        env=dict(os.environ))
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    artifact = json.loads(lines[-1])
+    assert artifact["round"] == 15
+    assert artifact["devices"] == 2
+    # Structural claims hold at ANY scale: the fault ledger fired, the
+    # breaker arc completed, every phase's machinery worked typed.
+    chaos = artifact["router_chaos"]
+    assert chaos["faults_fired"].get("dispatch_error", 0) >= 1
+    assert chaos["zero_client_errors"] is True
+    events = [e["event"] for e in chaos["health_timeline"]]
+    assert "quarantine" in events
+    assert artifact["degraded"]["ok"] is True
+    assert artifact["dispatcher"]["ok"] is True
+    assert artifact["export_watcher"]["ok"] is True
+    assert artifact["learner"]["parity"]["parity_ok"] is True
+    assert artifact["learner"]["live"] is None  # --ci skips the live run
+    if QUANT:
+      assert chaos["post_quarantine_p99_ok"] is True
+
+
+class TestCommittedFaultsArtifact:
+  """FAULTS_r15.json: schema + every acceptance bar, as committed."""
+
+  def test_committed_artifact_meets_bars(self):
+    path = os.path.join(ROOT, "FAULTS_r15.json")
+    assert os.path.exists(path), "FAULTS_r15.json not committed"
+    with open(path) as f:
+      artifact = json.load(f)
+    assert artifact["round"] == 15
+    assert artifact["devices"] == 8
+    chaos = artifact["router_chaos"]
+    # Bar 1: zero client-visible raw errors under the scripted
+    # retryable-fault schedule (sheds are typed and counted, never
+    # raw exceptions, never hangs).
+    assert chaos["zero_client_errors"] is True
+    assert chaos["chaos"]["client_failed_total"] == 0
+    # Bar 2: the full quarantine→probe→reinstate arc recorded.
+    events = [e["event"] for e in chaos["health_timeline"]]
+    assert chaos["quarantine_probe_reinstate_ok"] is True
+    assert events.index("quarantine") < events.index("probe")
+    assert events.index("probe") < events.index("reinstate")
+    # Bar 3: post-quarantine p99 back inside EVERY class budget.
+    assert chaos["post_quarantine_p99_ok"] is True
+    for entry in chaos["recovery"]["per_class"].values():
+      assert entry["latency_p99_ms"] <= entry["budget_ms"], entry
+    # Bar 4: the killed dispatcher restarted within budget.
+    assert chaos["dispatcher_restarts"] >= 1
+    # Bar 5: every injected fault's dump carries a correlation id
+    # where one was bound (replica/batcher faults ride request ids).
+    assert chaos["correlated_fault_dumps"] >= 1
+    # Bar 6: degraded mode sheds typed and by priority, still serving.
+    degraded = artifact["degraded"]
+    assert degraded["ok"] is True
+    assert degraded["raw_errors"] == 0
+    assert degraded["burst"]["priority_ordering_ok"] is True
+    # Bar 7: dispatcher + export phases.
+    assert artifact["dispatcher"]["ok"] is True
+    assert artifact["export_watcher"]["ok"] is True
+    assert artifact["export_watcher"]["accepted"] == [1, 3, 5]
+    # Bar 8: learner crash-resume — bit parity on the deterministic
+    # stream AND the live kill within the r14 TD tolerance.
+    parity = artifact["learner"]["parity"]
+    assert parity["parity_ok"] is True
+    assert parity["post_resume_stream_bit_equal"] is True
+    assert parity["max_post_resume_td_delta"] == 0.0
+    live = artifact["learner"]["live"]
+    assert live["ok"] is True
+    assert live["crashed_at"] == live["crash_at"]
+    assert live["converged_td_delta"] <= live["td_delta_bar"]
+    # Compact sentinels mirror the blocks.
+    assert artifact["fault_recovery_p99_ok"] is True
+    assert artifact["learner_resume_parity"] is True
+    assert artifact["virtual_mesh"] is True
