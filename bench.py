@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 15
+ROUND = 16
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -1071,6 +1071,27 @@ def _bench_faults_compact():
       live_resume=False, enforce_bars=False)
 
 
+def _bench_health_compact():
+  """Training-health sentinel block for the bench detail (ISSUE 15).
+
+  The committed chipless artifact (HEALTH_r16.json) carries the full
+  protocol — the instrumented fused loop's ledger-stability A/B,
+  every injected numeric corruption (nan_grads through anakin,
+  value_scale through the host loop, corrupt_served_variables against
+  a live router) detected within its rule's window, the fleet Q-drift
+  aggregate rollup, and the zero-false-positive healthy controls —
+  where detection LATENCY carries the virtual-mesh caveat. This block
+  is the driver-refreshable real-chip counterpart: a reduced run of
+  the same phases on the window's devices, where the in-program
+  summary's cost and the detection latency become chip numbers.
+  """
+  from tensor2robot_tpu.obs.health_bench import measure_health
+  return measure_health(
+      ledger_mesh_axis=1, ledger_dispatches=2, nan_steps=40,
+      nan_inject_at=10, scale_steps=30, scale_inject_at=15,
+      fleet_requests=120, control_steps=15, enforce_bars=False)
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1242,6 +1263,11 @@ def main() -> None:
   except Exception as e:
     faults = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    health = _bench_health_compact()
+  except Exception as e:
+    health = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1304,6 +1330,7 @@ def main() -> None:
       "obs": obs,
       "precision": precision,
       "faults": faults,
+      "health": health,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1371,6 +1398,15 @@ def main() -> None:
       # compact key.
       "fault_recovery_p99_ok": faults.get("fault_recovery_p99_ok"),
       "learner_resume_parity": faults.get("learner_resume_parity"),
+      # Health-sentinel sentinels (ISSUE 15): did every injected
+      # numeric corruption kind get detected within its rule's window
+      # (with the breach dumps schema-valid and correlated), and did
+      # the fleet Q-drift guard both catch the corrupted replica and
+      # stay silent on the healthy fleet. Null-safe under
+      # outage/error like every compact key.
+      "health_breach_detection_ok": health.get(
+          "health_breach_detection_ok"),
+      "fleet_q_drift_ok": health.get("fleet_q_drift_ok"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,
   }))

@@ -1,0 +1,24 @@
+"""Health-sentinel benchmark CLI: the bin/ face of obs/health_bench.
+
+    # The committed HEALTH_r16 protocol (chipless: the CLI bootstraps an
+    # 8-virtual-device CPU mesh and re-execs itself; acceptance bars
+    # are ENFORCED at generation time):
+    python -m tensor2robot_tpu.bin.bench_health --smoke --out HEALTH_r16.json
+
+    # Reduced tier-1 lane (2 devices, short windows, same structure):
+    python -m tensor2robot_tpu.bin.bench_health --ci
+
+Everything — the ledger-stability A/B of the instrumented fused loop,
+the injected numeric corruptions (nan_grads through anakin,
+value_scale through the host loop, corrupt_served_variables against a
+live router) with their detection bars, the fleet Q-drift aggregate
+rollup, and the zero-false-positive healthy controls — lives in
+obs/health_bench.py; this wrapper exists so the sentinel protocol is
+discoverable next to bench_faults in the bin/ surface every other
+measured artifact is produced from.
+"""
+
+from tensor2robot_tpu.obs.health_bench import main
+
+if __name__ == "__main__":
+  main()

@@ -34,6 +34,14 @@ Round 13 (ISSUE 12) extends the spine ACROSS processes:
   dump → callback escalation, and ``find_stragglers`` flags fleet
   members below a fraction of the median step rate.
 
+Round 15 (ISSUE 14) adds ``faults`` — deterministic seeded fault
+injection through explicit seams; round 16 (ISSUE 15) adds ``health``
+— the silent-failure sentinel: in-program training-health summaries
+computed inside the fused learn executables, a ``HealthMonitor`` of
+declarative rules (hard nonfinite, EWMA drift, bound floors)
+escalating through the rails above, and the fleet Q-drift guard over
+per-replica served-Q sketches.
+
 The Podracer analysis (PAPERS.md, arXiv:2104.06272) and the pjit/TPUv4
 scaling study (arXiv:2204.06514) both justify their architectures with
 exactly this per-executable utilization accounting; the multi-host and
@@ -45,6 +53,9 @@ from tensor2robot_tpu.obs.context import (bind, current_request_id,
                                           new_request_id)
 from tensor2robot_tpu.obs.flight_recorder import (FlightRecorder,
                                                   get_recorder)
+from tensor2robot_tpu.obs.health import (HealthHalt, HealthMonitor,
+                                         HealthRule, default_rules,
+                                         q_drift_report)
 from tensor2robot_tpu.obs.ledger import (ExecutableLedger,
                                          check_compile_ledger,
                                          peak_flops_for)
@@ -57,6 +68,9 @@ from tensor2robot_tpu.obs.watchdog import (Watchdog, find_stragglers,
 __all__ = [
     "ExecutableLedger",
     "FlightRecorder",
+    "HealthHalt",
+    "HealthMonitor",
+    "HealthRule",
     "MetricRegistry",
     "Tracer",
     "Watchdog",
@@ -64,6 +78,7 @@ __all__ = [
     "bind",
     "check_compile_ledger",
     "current_request_id",
+    "default_rules",
     "find_stragglers",
     "get_recorder",
     "get_registry",
@@ -71,6 +86,7 @@ __all__ = [
     "get_watchdog",
     "new_request_id",
     "peak_flops_for",
+    "q_drift_report",
     "set_device_annotations",
     "span",
 ]

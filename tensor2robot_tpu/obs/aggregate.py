@@ -185,12 +185,29 @@ def _merge_registries(paths: List[str]) -> dict:
       counters[name] = counters.get(name, 0) + int(value)
     gauges_per_host.setdefault(key, {}).update(
         snapshot.get("gauges", {}))
+    q_sketches = {}
     for name, hist in snapshot.get("histograms", {}).items():
       samples.setdefault(name, []).extend(hist.get("samples", []))
       counts[name] = counts.get(name, 0) + int(hist.get("count", 0))
+      # Per-replica served-Q reservoirs (ISSUE 15): summarized PER
+      # SOURCE — two hosts' replicas share device names, so pooling
+      # them by name would hide exactly the divergence the fleet
+      # Q-drift guard exists to see.
+      if (name.startswith("serving/replica/")
+          and name.endswith("/q_value")):
+        replica = name[len("serving/replica/"):-len("/q_value")]
+        reservoir = sorted(hist.get("samples", []))
+        if reservoir:
+          q_sketches[replica] = {
+              "count": int(hist.get("count", 0)),
+              "mean": round(sum(reservoir) / len(reservoir), 6),
+              "p50": round(_nearest_rank(reservoir, 50), 6),
+              "p90": round(_nearest_rank(reservoir, 90), 6),
+          }
     per_source.append({
         "process": key,
         "counters": snapshot.get("counters", {}),
+        "q_sketches": q_sketches,
     })
   histograms = {}
   for name, pooled in sorted(samples.items()):
@@ -264,6 +281,39 @@ def _slo_rollup(registries: dict) -> dict:
       "shed_total": shed_total,
       "requests_total": counters.get("serving/requests", 0),
       "consistent": bool(shed_total == global_shed and per_source_ok),
+  }
+
+
+def _health_rollup(registries: dict, flightrec: dict) -> dict:
+  """Fleet health verdict (ISSUE 15): breach counters summed across
+  processes, health_breach dumps schema-summarized, and the fleet
+  Q-DRIFT check run over EVERY process's per-replica served-Q sketches
+  (keys ``host:pid/replica``, so two hosts' same-named devices stay
+  distinct) — the cross-host form of the router's own
+  ``check_q_drift``. Verdict: "divergent" when any replica's served-Q
+  stream disagrees with the fleet, else "breaching" when any health
+  rule fired anywhere, else "ok" ("insufficient" q-data keeps the
+  breach-based verdict)."""
+  from tensor2robot_tpu.obs import health as health_lib
+
+  counters = {
+      name[len("health/"):]: int(value)
+      for name, value in registries["counters"].items()
+      if name.startswith("health/")}
+  fleet_sketches = {}
+  for source in registries["per_source"]:
+    for replica, summary in source.get("q_sketches", {}).items():
+      fleet_sketches[f"{source['process']}/{replica}"] = summary
+  q_drift = health_lib.q_drift_report(fleet_sketches)
+  breach_total = counters.get("breaches", 0)
+  divergent = q_drift["verdict"] == "divergent"
+  return {
+      "verdict": ("divergent" if divergent
+                  else "breaching" if breach_total else "ok"),
+      "breach_counters": counters,
+      "breach_total": breach_total,
+      "breach_dumps": len(flightrec.get("health_breaches", [])),
+      "q_drift": q_drift,
   }
 
 
@@ -392,10 +442,14 @@ def _merge_traces(paths: List[str], out_path: Optional[str]) -> dict:
 
 
 def _merge_flightrecs(paths: List[str]) -> dict:
-  """Summarizes every post-mortem dump; validates watchdog_stall ones."""
+  """Summarizes every post-mortem dump; validates watchdog_stall and
+  health_breach ones against their trigger schemas."""
+  from tensor2robot_tpu.obs import health as health_lib
+
   reasons: Dict[str, int] = {}
   by_process: Dict[str, int] = {}
   watchdog_stalls = []
+  health_breaches = []
   request_ids = []
   invalid = []
   for path in sorted(paths):
@@ -422,12 +476,26 @@ def _merge_flightrecs(paths: List[str]) -> dict:
           "schema_ok": not missing,
           "missing_fields": missing,
       })
+    elif reason == "health_breach":
+      trigger = payload.get("trigger", {})
+      missing = [field for field in health_lib.BREACH_FIELDS
+                 if field not in trigger]
+      health_breaches.append({
+          "file": os.path.basename(path),
+          "process": key,
+          "rule": trigger.get("rule"),
+          "metric": trigger.get("metric"),
+          "step": trigger.get("step"),
+          "schema_ok": not missing,
+          "missing_fields": missing,
+      })
   return {
       "dumps": sum(reasons.values()),
       "reasons": reasons,
       "by_process": by_process,
       "request_ids": request_ids[:16],
       "watchdog_stalls": watchdog_stalls,
+      "health_breaches": health_breaches,
       "invalid": invalid,
   }
 
@@ -447,6 +515,7 @@ def aggregate_logdir(logdir: str,
                if merged_trace and inputs["trace"] else None)
   trace = _merge_traces(inputs["trace"], trace_out)
   flightrec = _merge_flightrecs(inputs["flightrec"])
+  health = _health_rollup(registries, flightrec)
   rates = {key: entry["step_rate"]
            for key, entry in per_process.items()
            if entry["step_rate"] is not None}
@@ -468,6 +537,7 @@ def aggregate_logdir(logdir: str,
           "gauges_per_host": registries["gauges_per_host"],
       },
       "slo": slo,
+      "health": health,
       "trace": trace,
       "flightrec": flightrec,
       "stragglers": stragglers,

@@ -67,7 +67,22 @@ FAULT_KINDS = (
     "export_corrupt",         # export npz overwritten with garbage
     "export_partial_write",   # export npz truncated mid-file
     "crash",                  # learner raises InjectedCrash at a step
+    "nan_grads",              # learner data poisoned non-finite (ISSUE 15)
+    "value_scale",            # learner values scaled finite-but-wrong
+    "corrupt_served_variables",  # replica serves a corrupted param tree
 )
+
+# The SILENT kinds (ISSUE 15): they never raise or stall — they corrupt
+# DATA and keep running, which is exactly the failure mode the health
+# sentinel (obs/health.py) exists to catch. ``perturb`` returns the
+# fired numeric specs so the owning seam can apply the corruption to
+# its own state: the learner seams poison targets / params
+# (`apply_numeric_to_targets` / `corrupt_train_state`), the replica
+# dispatch seam installs a corrupted served-variables tree
+# (`corrupt_variables`) that still returns plausible finite numbers —
+# the botched-hot-swap model the fleet Q-drift guard detects.
+NUMERIC_KINDS = frozenset(
+    {"nan_grads", "value_scale", "corrupt_served_variables"})
 
 
 class InjectedFault(RuntimeError):
@@ -123,6 +138,10 @@ class FaultSpec:
     probability: seeded Bernoulli per check (alternative to `at`;
       deterministic given the plan seed and the call sequence).
     latency_s: stall duration for latency_spike / hung_flush.
+    scale: corruption factor for the numeric kinds — value_scale
+      multiplies the learner's Bellman targets by it,
+      corrupt_served_variables scales a replica's served float params
+      by it (finite, plausible, wrong). Ignored by the other kinds.
   """
 
   kind: str
@@ -133,6 +152,7 @@ class FaultSpec:
   count: int = 1
   probability: float = 0.0
   latency_s: float = 0.0
+  scale: float = 8.0
 
   def __post_init__(self):
     if self.kind not in FAULT_KINDS:
@@ -235,14 +255,21 @@ class FaultPlan:
       pass  # diagnostics never break the injection (listener contract)
 
   def perturb(self, point: str, site: str = "",
-              index: Optional[int] = None) -> None:
+              index: Optional[int] = None) -> List[FaultSpec]:
     """The one-line seam: check the schedule and ACT on what fires —
     sleep for latency faults, raise for error/kill/crash faults. When
     several specs fire on one tick, stalls apply first (a fault that
-    both delays and then fails models a timing-out dispatch)."""
+    both delays and then fails models a timing-out dispatch).
+
+    Returns the fired NUMERIC specs (NUMERIC_KINDS): those never raise
+    or stall here — the seam owns the corruption (targets, params, a
+    served-variables tree) and applies it with the helpers below. A
+    numeric spec co-scheduled with a raising kind on the same tick is
+    lost to the raise; schedule silent and loud faults on distinct
+    ticks."""
     fired = self.check(point, site, index=index)
     if not fired:
-      return
+      return []
     for spec in fired:
       if spec.kind in ("latency_spike", "hung_flush") and spec.latency_s:
         time.sleep(spec.latency_s)
@@ -253,6 +280,7 @@ class FaultPlan:
         raise InjectedKill(point, site)
       if spec.kind == "crash":
         raise InjectedCrash(index if index is not None else -1)
+    return [spec for spec in fired if spec.kind in NUMERIC_KINDS]
 
   def fired_counts(self) -> Dict[str, int]:
     """{kind: times fired} — the chaos artifact's injection ledger."""
@@ -269,6 +297,68 @@ class FaultPlan:
           "specs": [dataclasses.asdict(spec) for spec in self.specs],
           "fired": [dict(record) for record in self.fired],
       }
+
+
+def apply_numeric_to_targets(targets, specs: Sequence[FaultSpec]):
+  """Applies fired numeric specs to a host Bellman-target batch (the
+  host learner seam's corruption point): ``nan_grads`` poisons one
+  label with NaN — the loss mean goes NaN, so the REAL backward pass
+  produces genuinely non-finite gradients, not a simulated flag;
+  ``value_scale`` multiplies every target by spec.scale (a finite
+  value explosion the drift rules must catch). Returns a fresh array;
+  the input is never mutated."""
+  out = np.asarray(targets, np.float32).copy()
+  for spec in specs:
+    if spec.kind == "nan_grads":
+      out.reshape(-1)[0] = np.nan
+    elif spec.kind == "value_scale":
+      out = out * np.float32(spec.scale)
+  return out
+
+
+def corrupt_train_state(state, specs: Sequence[FaultSpec]):
+  """Applies fired numeric specs to a fused learner's TrainState (the
+  anakin/megastep seam, between dispatches — donated device state has
+  no in-program seam, so corruption lands where a preemption-era
+  memory fault would: on the carried params). ``nan_grads`` NaNs the
+  first param leaf (the next learn iteration's forward, loss, and
+  gradients all go genuinely non-finite); ``value_scale`` scales every
+  float param leaf by spec.scale (finite Q explosion). Returns a new
+  TrainState; shardings ride along with the elementwise ops."""
+  import jax
+  import jax.numpy as jnp
+
+  params = state.params
+  for spec in specs:
+    if spec.kind == "nan_grads":
+      leaves, treedef = jax.tree_util.tree_flatten(params)
+      # Leaf-dtype NaN: a strongly-typed f32 NaN would silently
+      # promote a bf16/f64 leaf and the next dispatch's AOT executable
+      # would reject the drifted aval instead of detecting the NaN.
+      leaves = [leaves[0] * jnp.asarray(jnp.nan, leaves[0].dtype)
+                ] + leaves[1:]
+      params = jax.tree_util.tree_unflatten(treedef, leaves)
+    elif spec.kind == "value_scale":
+      params = jax.tree_util.tree_map(
+          lambda leaf: leaf * jnp.asarray(spec.scale, leaf.dtype)
+          if jnp.issubdtype(leaf.dtype, jnp.floating) else leaf,
+          params)
+  return state.replace(params=params)
+
+
+def corrupt_variables(variables, scale: float):
+  """A finite-but-wrong copy of a served variables pytree: every float
+  leaf scaled by ``scale`` — the ``corrupt_served_variables`` model of
+  a botched ``set_variables`` hot-swap. The replica keeps answering
+  with plausible numbers; only the fleet Q-drift guard (cross-replica
+  served-Q divergence) can see it."""
+  import jax
+  import jax.numpy as jnp
+
+  return jax.tree_util.tree_map(
+      lambda leaf: leaf * jnp.asarray(scale, leaf.dtype)
+      if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating) else leaf,
+      variables)
 
 
 def damage_export(export_dir: str, kind: str,

@@ -1,0 +1,530 @@
+"""Silent-failure sentinel: training/serving health as first-class data.
+
+PR 11 made LOUD failures (crashes, dead dispatchers, corrupt exports) a
+handled regime; the classic large-scale-training failure mode is
+SILENT — non-finite grads, Q-value explosion, replay-priority collapse,
+a replica serving plausible-but-wrong values after a bad hot-swap.
+Nothing crashes; the loop trains on garbage for hours. This module is
+the sentinel that pages instead, in three layers:
+
+- **In-program health summaries**: a small FIXED-SHAPE pytree of scalar
+  reductions per learn iteration — non-finite counts over grads /
+  params / targets (``jnp.isfinite`` sums), global grad/param norms, TD
+  and Q mean/max, replay priority entropy, and sample age — computed
+  INSIDE the already-compiled learn bodies (the fused ``anakin_step`` /
+  ``megastep`` scan carries them; the host loop assembles the same
+  keys per optimizer step). Cost is a handful of reductions riding the
+  existing metrics D2H: zero new executables in the fused ledgers,
+  host-blocked unchanged.
+- **``HealthMonitor`` + declarative ``HealthRule``s**: a hard
+  nonfinite==0 rule, EWMA/z-score drift rules for grad norm / TD / Q,
+  and staleness / priority-entropy bound rules, escalating through the
+  existing rails — registry counters (``health/...``) → a
+  schema-validated ``health_breach`` flight-recorder dump carrying the
+  step and any bound correlation ids → an optional callback → an
+  optional auto-action that snapshots a checkpoint (the PR 11
+  machinery) and, configurably, HALTS (``HealthHalt``) rather than
+  training on garbage.
+- **Fleet Q-drift guard**: per-replica streaming quantile sketches of
+  served Q-values (``serving.stats.ServingStats``) compared against
+  the fleet median (``q_drift_report``) — the check that catches a
+  corrupted replica or a botched ``set_variables`` that still returns
+  finite numbers. The router rolls the verdict into
+  ``health_snapshot()`` and fires ``replica_divergent``;
+  ``obs/aggregate.py`` runs the same report fleet-wide across
+  processes.
+
+The Podracer and pjit/TPUv4 scaling papers (PAPERS.md) both treat
+cheap in-program health reductions as the precondition for running
+fused/multi-host loops unattended — this module is that precondition
+for ROADMAP item 1's operating mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import statistics
+import threading
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
+
+from tensor2robot_tpu.obs import context as context_lib
+from tensor2robot_tpu.obs import flight_recorder as flight_lib
+from tensor2robot_tpu.obs import registry as registry_lib
+
+# The fixed health-summary schema every learn path emits (fused bodies
+# compute these in-program; the host loop assembles the same keys from
+# its per-step host data). One schema — a rule written against a key
+# holds on every loop path.
+SUMMARY_KEYS = (
+    "health/nonfinite_grads",
+    "health/nonfinite_params",
+    "health/nonfinite_targets",
+    "health/grad_norm",
+    "health/param_norm",
+    "health/td_mean",
+    "health/td_max",
+    "health/q_mean",
+    "health/q_max",
+    "health/priority_entropy",
+    "health/sample_age",
+)
+
+# Keys aggregated by RUNNING MAX across a fused scan's inner
+# iterations (a transient mid-scan NaN or spike must survive to the
+# dispatch boundary); the rest report the last trained iteration.
+SCAN_MAX_KEYS = frozenset({
+    "health/nonfinite_grads",
+    "health/nonfinite_params",
+    "health/nonfinite_targets",
+    "health/grad_norm",
+    "health/td_max",
+    "health/q_max",
+})
+
+# Event schema for health_breach flight-recorder triggers — the
+# aggregator validates dumps against these fields (the watchdog's
+# STALL_FIELDS convention).
+BREACH_FIELDS = ("rule", "metric", "value", "step")
+
+
+class HealthHalt(RuntimeError):
+  """Raised by a halting HealthMonitor breach: the loop stops INSTEAD
+  of training on garbage. Carries the breaches that tripped it."""
+
+  def __init__(self, step: int, breaches: List[dict]):
+    self.step = step
+    self.breaches = breaches
+    names = ", ".join(sorted({b["rule"] for b in breaches}))
+    super().__init__(
+        f"health halt at step {step}: breached [{names}] — halting "
+        "rather than training on garbage (see the health_breach "
+        "flight-recorder dump)")
+
+
+# -- pure jittable reductions (the in-program summary pieces) ---------------
+
+
+def tree_nonfinite_count(tree):
+  """Total non-finite elements across a pytree's float leaves, as one
+  f32 scalar (jittable — the hard-rule input, computed in-program)."""
+  import jax
+  import jax.numpy as jnp
+
+  total = jnp.zeros((), jnp.float32)
+  for leaf in jax.tree_util.tree_leaves(tree):
+    leaf = jnp.asarray(leaf)
+    if jnp.issubdtype(leaf.dtype, jnp.floating):
+      total = total + jnp.sum(~jnp.isfinite(leaf)).astype(jnp.float32)
+  return total
+
+
+def tree_global_norm(tree):
+  """Global L2 norm over a pytree's float leaves (f32, jittable)."""
+  import jax
+  import jax.numpy as jnp
+
+  total = jnp.zeros((), jnp.float32)
+  for leaf in jax.tree_util.tree_leaves(tree):
+    leaf = jnp.asarray(leaf)
+    if jnp.issubdtype(leaf.dtype, jnp.floating):
+      total = total + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+  return jnp.sqrt(total)
+
+
+def merge_scan_metrics(new: Dict, old: Dict, gate):
+  """Per-key scan-carry merge for the fused loops: when ``gate`` is
+  true the SCAN_MAX_KEYS keep their running max (a spike inside the
+  scan survives to the dispatch readout) and every other key takes the
+  new value; when false the old carry rides through unchanged."""
+  import jax.numpy as jnp
+
+  out = {}
+  for key, new_value in new.items():
+    old_value = old[key]
+    if key in SCAN_MAX_KEYS:
+      out[key] = jnp.where(gate, jnp.maximum(new_value, old_value),
+                           old_value)
+    else:
+      out[key] = jnp.where(gate, new_value, old_value)
+  return out
+
+
+def reduce_scanned_metrics(stacked: Dict):
+  """The megastep form of the same aggregation: metrics stacked along
+  the scan axis reduce per key — max for SCAN_MAX_KEYS, last
+  otherwise (the host-loop last-step convention)."""
+  return {key: (value.max(axis=0) if key in SCAN_MAX_KEYS
+                else value[-1])
+          for key, value in stacked.items()}
+
+
+def zero_summary():
+  """The fixed-shape all-zeros summary (the fused loops' scan-carry
+  init; also the 'never trained yet' placeholder)."""
+  import jax.numpy as jnp
+
+  return {key: jnp.zeros((), jnp.float32) for key in SUMMARY_KEYS}
+
+
+# -- declarative rules ------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthRule:
+  """One declarative check over one summary metric.
+
+  Attributes:
+    name: rule id (registry counter ``health/<name>``, breach field).
+    metric: the summary key it watches.
+    kind: "max" (hard ceiling: value > limit breaches — the
+      nonfinite==0 rule is ``max`` with limit 0), "min" (floor:
+      value < limit breaches — the priority-entropy collapse rule), or
+      "drift" (EWMA z-score: |value - ewma_mean| / ewma_std >
+      z_threshold after ``warmup`` observations).
+    limit: the bound for max/min rules.
+    z_threshold / ewma_alpha / min_std / min_rel_std: drift-rule
+      statistics. The z denominator is floored at
+      ``max(min_std, min_rel_std * |ewma_mean|)`` — a healthy series
+      that settles to near-constant values must not turn ordinary
+      fluctuation into a breach just because its variance collapsed
+      (the false-positive mode of a raw z-score). The EWMA state
+      FREEZES on a breaching observation, so persistent corruption
+      keeps breaching instead of teaching the baseline to accept it.
+    warmup: observations before min/drift rules arm (a cold loop's
+      first steps are legitimately wild; hard max rules with warmup 0
+      are always armed — a NaN is never a warm-up artifact).
+    halt: a breach of this rule escalates to HealthHalt when the
+      monitor runs with halt_on_breach.
+  """
+
+  name: str
+  metric: str
+  kind: str = "max"
+  limit: float = 0.0
+  z_threshold: float = 6.0
+  ewma_alpha: float = 0.1
+  min_std: float = 1e-3
+  min_rel_std: float = 0.25
+  warmup: int = 10
+  halt: bool = False
+
+  def __post_init__(self):
+    if self.kind not in ("max", "min", "drift"):
+      raise ValueError(f"unknown rule kind {self.kind!r}; "
+                       "known: max, min, drift")
+
+
+def default_rules(capacity: Optional[int] = None) -> tuple:
+  """The sentinel's default rule set (ISSUE 15): hard nonfinite==0
+  everywhere numbers can go non-finite, drift rules on grad norm / TD /
+  Q (the value-explosion detectors), a priority-entropy floor (replay
+  priority collapse: one transition dominating the sampling
+  distribution), and — when the ring capacity is known — a sample-age
+  ceiling (replay gone stale: the learner replaying ancient data while
+  ingest silently died)."""
+  rules = [
+      HealthRule("nonfinite_grads", "health/nonfinite_grads",
+                 kind="max", limit=0.0, warmup=0, halt=True),
+      HealthRule("nonfinite_params", "health/nonfinite_params",
+                 kind="max", limit=0.0, warmup=0, halt=True),
+      HealthRule("nonfinite_targets", "health/nonfinite_targets",
+                 kind="max", limit=0.0, warmup=0, halt=True),
+      HealthRule("grad_norm_drift", "health/grad_norm", kind="drift",
+                 z_threshold=8.0, warmup=10),
+      HealthRule("td_drift", "health/td_mean", kind="drift",
+                 z_threshold=8.0, warmup=10),
+      HealthRule("q_drift", "health/q_max", kind="drift",
+                 z_threshold=8.0, warmup=10),
+      HealthRule("priority_entropy_floor", "health/priority_entropy",
+                 kind="min", limit=0.05, warmup=10),
+  ]
+  if capacity is not None:
+    rules.append(HealthRule("sample_age_ceiling", "health/sample_age",
+                            kind="max", limit=float(8 * capacity),
+                            warmup=5))
+  return tuple(rules)
+
+
+class _DriftState:
+  """EWMA mean/variance for one drift rule (exponentially weighted
+  moments, Welford-style update)."""
+
+  __slots__ = ("n", "mean", "var")
+
+  def __init__(self):
+    self.n = 0
+    self.mean = 0.0
+    self.var = 0.0
+
+  def update(self, value: float, alpha: float) -> None:
+    if self.n == 0:
+      self.mean = value
+    else:
+      delta = value - self.mean
+      self.mean += alpha * delta
+      self.var = (1.0 - alpha) * (self.var + alpha * delta * delta)
+    self.n += 1
+
+  def std(self, min_std: float, min_rel_std: float = 0.0) -> float:
+    return max(math.sqrt(max(self.var, 0.0)), min_std,
+               min_rel_std * abs(self.mean))
+
+
+class HealthMonitor:
+  """Evaluates HealthRules over per-step summaries; escalates breaches.
+
+  Escalation per breach, every hop exception-isolated (the PR 8
+  listener contract — diagnostics never crash the observed loop):
+
+    registry counters (``health/breaches`` + ``health/<rule>``)
+    → rate-limited ``health_breach`` flight-recorder dump (BREACH_FIELDS
+      schema, stamped with the step and any bound correlation ids)
+    → optional ``on_breach`` callback
+    → optional ``snapshot_fn`` (the loop's checkpoint machinery: freeze
+      the last-known state beside the post-mortem)
+    → with ``halt_on_breach``, a breach of a ``halt`` rule raises
+      ``HealthHalt`` AFTER the escalation above — the one hop that is
+      deliberately NOT isolated, because stopping is the action.
+
+  Thread-safety: observe() is called from one loop thread; the lock
+  guards snapshot() readers.
+  """
+
+  def __init__(self, rules: Optional[Sequence[HealthRule]] = None,
+               registry: Optional[registry_lib.MetricRegistry] = None,
+               recorder: Optional[flight_lib.FlightRecorder] = None,
+               on_breach: Optional[Callable[[dict], None]] = None,
+               halt_on_breach: bool = False,
+               max_breach_history: int = 256):
+    self.rules = tuple(default_rules() if rules is None else rules)
+    names = [rule.name for rule in self.rules]
+    if len(set(names)) != len(names):
+      raise ValueError(f"duplicate rule names: {sorted(names)}")
+    self._registry = registry
+    self._recorder = recorder
+    self._on_breach = on_breach
+    self.halt_on_breach = halt_on_breach
+    self._lock = threading.Lock()
+    self._drift: Dict[str, _DriftState] = {
+        rule.name: _DriftState() for rule in self.rules
+        if rule.kind == "drift"}
+    self._seen: Dict[str, int] = {rule.name: 0 for rule in self.rules}
+    self.observations = 0
+    self.breaches: List[dict] = []
+    self._max_breaches = max_breach_history
+    self.breach_count = 0
+    self.last_summary: Dict[str, float] = {}
+
+  def _check_rule(self, rule: HealthRule, value: float,
+                  step: int) -> Optional[dict]:
+    """One rule against one value; updates rule state. Returns the
+    breach record or None."""
+    seen = self._seen[rule.name]
+    self._seen[rule.name] = seen + 1
+    breach: Optional[dict] = None
+    if rule.kind == "max":
+      if seen >= rule.warmup and value > rule.limit:
+        breach = {"threshold": rule.limit}
+    elif rule.kind == "min":
+      if seen >= rule.warmup and value < rule.limit:
+        breach = {"threshold": rule.limit}
+    else:  # drift
+      state = self._drift[rule.name]
+      if state.n >= rule.warmup:
+        std = state.std(rule.min_std, rule.min_rel_std)
+        z = abs(value - state.mean) / std
+        if z > rule.z_threshold:
+          breach = {"z": round(z, 3), "ewma_mean": round(state.mean, 6),
+                    "ewma_std": round(std, 6),
+                    "threshold": rule.z_threshold}
+      if breach is None:
+        # Freeze the baseline on breach: persistent corruption must
+        # keep breaching, not teach the EWMA its new normal.
+        state.update(value, rule.ewma_alpha)
+    if breach is None:
+      return None
+    breach.update({
+        "rule": rule.name, "metric": rule.metric,
+        "value": float(value), "step": int(step), "kind": rule.kind,
+        "halt": rule.halt,
+    })
+    return breach
+
+  def observe(self, step: int, summary: Mapping[str, float]
+              ) -> List[dict]:
+    """One per-step summary through every rule. Returns the breaches
+    (already escalated); raises HealthHalt when a halting rule
+    breached under halt_on_breach."""
+    return self.observe_with_snapshot(step, summary, snapshot_fn=None)
+
+  def observe_with_snapshot(
+      self, step: int, summary: Mapping[str, float],
+      snapshot_fn: Optional[Callable[[], None]] = None) -> List[dict]:
+    """observe() + the auto-action: ``snapshot_fn`` (the loop's
+    checkpoint closure) runs once when any rule breached, BEFORE a
+    halt — the post-mortem gets the state that breached."""
+    breaches: List[dict] = []
+    with self._lock:
+      self.observations += 1
+      self.last_summary = {key: float(value)
+                           for key, value in summary.items()}
+      for rule in self.rules:
+        value = summary.get(rule.metric)
+        if value is None:
+          continue
+        value = float(value)
+        if math.isnan(value) and rule.kind == "drift":
+          # A NaN metric is the hard rules' jurisdiction; feeding it
+          # to an EWMA would poison the baseline forever.
+          continue
+        breach = self._check_rule(rule, value, step)
+        if breach is not None:
+          breaches.append(breach)
+      self.breach_count += len(breaches)
+      self.breaches.extend(breaches)
+      if len(self.breaches) > self._max_breaches:
+        del self.breaches[:len(self.breaches) - self._max_breaches]
+    for breach in breaches:
+      self._escalate(breach)
+    if breaches and snapshot_fn is not None:
+      try:
+        snapshot_fn()
+      except Exception:
+        pass  # the snapshot is best-effort; the breach record stands
+    if self.halt_on_breach:
+      halting = [b for b in breaches if b.get("halt")]
+      if halting:
+        raise HealthHalt(step, halting)
+    return breaches
+
+  def _escalate(self, breach: dict) -> None:
+    """counters → rate-limited dump (step + correlation ids) →
+    callback; each hop exception-isolated."""
+    try:
+      registry = self._registry or registry_lib.get_registry()
+      registry.counter("health/breaches").inc()
+      registry.counter(f"health/{breach['rule']}").inc()
+    except Exception:
+      pass
+    try:
+      recorder = self._recorder or flight_lib.get_recorder()
+      fields = {key: breach[key] for key in BREACH_FIELDS}
+      fields.update({key: breach[key] for key in ("z", "threshold")
+                     if key in breach})
+      # Bound correlation/step ids ride the dump exactly like an
+      # injected fault's (obs/faults.py contract).
+      attrs = context_lib.context_attrs()
+      fields.update({key: attrs[key]
+                     for key in ("request_id", "request_ids", "step_id")
+                     if key in attrs})
+      recorder.trigger("health_breach", **fields)
+    except Exception:
+      pass
+    if self._on_breach is not None:
+      try:
+        self._on_breach(breach)
+      except Exception:
+        pass
+
+  def snapshot(self) -> dict:
+    """Artifact-ready monitor state: rule table, breach history,
+    per-rule counts, the last summary observed."""
+    with self._lock:
+      per_rule: Dict[str, int] = {}
+      for breach in self.breaches:
+        per_rule[breach["rule"]] = per_rule.get(breach["rule"], 0) + 1
+      return {
+          "rules": [{
+              "name": rule.name, "metric": rule.metric,
+              "kind": rule.kind, "halt": rule.halt,
+          } for rule in self.rules],
+          "observations": self.observations,
+          "breach_count": self.breach_count,
+          "breaches_per_rule": per_rule,
+          "breaches": [dict(breach) for breach in self.breaches],
+          "last_summary": dict(self.last_summary),
+      }
+
+
+# -- fleet Q-drift guard ----------------------------------------------------
+
+
+def q_drift_report(replica_summaries: Mapping[str, Mapping],
+                   z_threshold: float = 8.0,
+                   min_samples: int = 16,
+                   min_scale: float = 1e-4) -> dict:
+  """Cross-replica served-Q divergence vs the fleet (leave-one-out).
+
+  ``replica_summaries`` maps a replica label to its served-Q sketch
+  summary ({"count", "mean", "p50", "p90", ...} — ServingStats'
+  ``q_sketch_summaries`` shape, or the aggregator's per-process form).
+  Every replica serves the same request distribution through the same
+  params, so their served-Q MEANS must agree up to sampling noise; one
+  that doesn't is serving a different function (a corrupted replica,
+  a botched ``set_variables`` that still returns finite numbers).
+
+  The check is scale-free — Q heads range from ~1e-3 logits (the CI
+  critics) to order-1 values, so no absolute threshold can be a
+  default. For each qualifying replica (>= ``min_samples`` served
+  values): the FLEET CENTER is the median of the OTHER replicas'
+  means (leave-one-out, so the candidate cannot pull its own
+  yardstick), and the SCALE is the larger of (a) the other replicas'
+  median absolute deviation around that center and (b) half their
+  median within-replica p90-p50 spread — MAD is zero at fleet size 2,
+  where the within-replica dispersion is the honest noise floor —
+  floored at ``min_scale``. A replica whose |mean - center| exceeds
+  ``z_threshold`` x scale is DIVERGENT. (At fleet size 2 the guard
+  cannot name the culprit — both sides of a wide gap flag — but the
+  alarm still fires; >= 3 replicas isolate the corrupted one.)
+
+  Verdicts: "ok", "divergent" (names in ``divergent``), or
+  "insufficient" (< 2 qualifying replicas: no fleet to diverge from).
+  """
+  qualifying = {
+      name: summary for name, summary in replica_summaries.items()
+      if summary.get("count", 0) >= min_samples
+      and summary.get("mean") is not None}
+  report = {
+      "z_threshold": z_threshold,
+      "min_samples": min_samples,
+      "min_scale": min_scale,
+      "replicas": {},
+      "divergent": [],
+      "fleet_median": None,
+  }
+  for name, summary in sorted(replica_summaries.items()):
+    report["replicas"][name] = {
+        "count": int(summary.get("count", 0)),
+        "mean": summary.get("mean"),
+        "median": summary.get("p50"),
+        "qualifying": name in qualifying,
+    }
+  if len(qualifying) < 2:
+    report["verdict"] = "insufficient"
+    return report
+  means = {name: float(summary["mean"])
+           for name, summary in qualifying.items()}
+  spreads = {
+      name: max(float(summary.get("p90") or 0.0)
+                - float(summary.get("p50") or 0.0), 0.0)
+      for name, summary in qualifying.items()}
+  report["fleet_median"] = round(statistics.median(means.values()), 6)
+  for name in qualifying:
+    others = [means[other] for other in qualifying if other != name]
+    center = statistics.median(others)
+    mad = statistics.median(
+        abs(value - center) for value in others)
+    spread_floor = 0.5 * statistics.median(
+        spreads[other] for other in qualifying if other != name)
+    scale = max(mad, spread_floor, min_scale)
+    z = abs(means[name] - center) / scale
+    entry = report["replicas"][name]
+    entry["delta"] = round(abs(means[name] - center), 6)
+    entry["z"] = round(z, 3)
+    if z > z_threshold:
+      entry["divergent"] = True
+      report["divergent"].append(name)
+  report["divergent"].sort()
+  report["verdict"] = "divergent" if report["divergent"] else "ok"
+  return report

@@ -132,6 +132,7 @@ class AnakinLoop(TargetNetwork):
       polyak_tau: Optional[float] = None,
       ledger: Optional[obs_ledger.ExecutableLedger] = None,
       precision: str = "f32",
+      health: bool = False,
   ):
     """`precision` (ISSUE 13, cem.SCORING_PRECISIONS) is the CEM
     Q-scoring tier INSIDE the fused executable: acting's score calls
@@ -139,7 +140,16 @@ class AnakinLoop(TargetNetwork):
     replay extend, gradients, optimizer state, and the TD-priority
     arithmetic (the learn body's fresh-params forward) stay f32 — the
     low-precision-matmuls / f32-updates convention. "f32" (default)
-    lowers the program bit-identically to r10."""
+    lowers the program bit-identically to r10.
+
+    `health` (ISSUE 15): the scanned learn body additionally computes
+    the fixed health-summary pytree (obs/health.SUMMARY_KEYS) —
+    non-finite counts over grads/params/targets, grad/param norms,
+    TD/Q mean/max, priority entropy, sample age — accumulated in the
+    scan carry (running max for the spike-sensitive keys) and returned
+    with the metrics. Still ONE `anakin_step` in the ledger: the cost
+    is a few scalar reductions riding the existing metrics D2H, so
+    host-blocked stays at its r09 level."""
     if inner_steps < 1 or train_every < 1 or inner_steps % train_every:
       raise ValueError(
           f"inner_steps {inner_steps} must be a positive multiple of "
@@ -202,6 +212,7 @@ class AnakinLoop(TargetNetwork):
     # detail["anakin"]["dtype"] / the smoke artifact.
     self.precision = cem.validate_precision(precision)
     self.dtype = jnp.dtype(cem.scoring_dtype(precision)).name
+    self.health = bool(health)
     self.compile_counts: Dict[str, int] = {}
     self._ledger = ledger
     self._exec = None
@@ -280,9 +291,12 @@ class AnakinLoop(TargetNetwork):
       constrain_carry = lambda e, b: (e, b)
       constrain_actions = lambda a: a
     learn = make_learn_iteration_fn(
-        model, self._trainer.train_step_fn(), sample, update_priorities,
+        model, self._trainer.train_step_fn(with_health=self.health),
+        sample, update_priorities,
         targets_fn, getattr(model, "target_key", "target_q"),
-        self._clip_targets, constrain_batch=constrain_batch)
+        self._clip_targets, constrain_batch=constrain_batch,
+        health_entropy_fn=(self._buffer.priority_entropy_fn()
+                           if self.health else None))
     n = self._env.num_envs
     batch_size = self._buffer.sample_batch_size
     k = self.inner_steps
@@ -339,6 +353,9 @@ class AnakinLoop(TargetNetwork):
         "q_next": jnp.zeros((), jnp.float32),
         "staleness": jnp.zeros((), jnp.float32),
     }
+    if self.health:
+      from tensor2robot_tpu.obs import health as health_lib
+      zero_metrics.update(health_lib.zero_summary())
 
     def anakin_step(train_state, env_state, buffer_state,
                     target_variables, outer_step):
@@ -382,10 +399,15 @@ class AnakinLoop(TargetNetwork):
         # scan iteration (and therefore across dispatches: the donated
         # outputs re-enter at the same shardings the AOT lowering saw).
         env_state, buffer_state = constrain_carry(env_state, buffer_state)
-        # Keep the LAST TRAINED metrics (skipped steps report zeros).
-        last_metrics = jax.tree_util.tree_map(
-            lambda new, old: jnp.where(do_train, new, old),
-            metrics, last_metrics)
+        # Keep the LAST TRAINED metrics (skipped steps report zeros);
+        # the spike-sensitive health keys instead accumulate a RUNNING
+        # MAX in the carry so a transient mid-scan NaN or norm spike
+        # survives to the dispatch readout (obs/health.SCAN_MAX_KEYS;
+        # without health keys this reduces to the plain last-trained
+        # merge).
+        from tensor2robot_tpu.obs import health as health_lib
+        last_metrics = health_lib.merge_scan_metrics(
+            metrics, last_metrics, do_train)
         trained = do_train.astype(jnp.int32)
         return (train_state, env_state, buffer_state,
                 last_metrics), trained

@@ -561,6 +561,32 @@ class DeviceReplayBuffer:
   def fill_fraction(self) -> float:
     return self.size / self.capacity
 
+  def priority_entropy_fn(self) -> Callable:
+    """PURE jittable (state) -> f32 normalized priority entropy — the
+    in-program form of ``priority_entropy`` below, for the fused
+    health summaries (obs/health.py): a few reductions over the tree's
+    leaf level inside the already-compiled learn body, so replay
+    priority collapse is visible per learn iteration without a host
+    readback. Uniform buffers and degenerate sizes read 1.0 (the
+    host-path convention)."""
+    if not self._prioritized:
+      return lambda state: jnp.ones((), jnp.float32)
+    n_leaves, capacity = self._n_leaves, self.capacity
+
+    def entropy(state: DeviceReplayState) -> jnp.ndarray:
+      leaves = jax.lax.dynamic_slice(state.tree, (n_leaves,),
+                                     (capacity,))
+      size = jnp.maximum(state.size, 1)
+      filled = jnp.arange(capacity, dtype=jnp.int32) < size
+      weights = jnp.where(filled, leaves, 0.0)
+      total = jnp.maximum(weights.sum(), jnp.float32(1e-30))
+      p = weights / total
+      ent = -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+      norm = jnp.log(jnp.maximum(size.astype(jnp.float32), 2.0))
+      return jnp.where(size <= 1, jnp.float32(1.0), ent / norm)
+
+    return entropy
+
   def priority_entropy(self) -> float:
     """Normalized entropy of the sampling distribution (host-path
     semantics: 1.0 for uniform buffers and degenerate sizes)."""
@@ -588,7 +614,8 @@ class DeviceReplayBuffer:
 
 def make_learn_iteration_fn(model, step_fn, sample, update_priorities,
                             targets_fn, target_key, clip_targets,
-                            constrain_batch=None):
+                            constrain_batch=None,
+                            health_entropy_fn=None):
   """ONE sample→CEM-Bellman-label→train→reprioritize iteration as a
   pure closure — THE learner inner body, extracted so the megastep
   (which lax.scans it K times) and the fused Anakin loop
@@ -611,6 +638,16 @@ def make_learn_iteration_fn(model, step_fn, sample, update_priorities,
   exactly as in Trainer's supervised path). None (the megastep's
   single-shape contract, where sample_batch_size need not divide the
   axis) leaves placement to propagation.
+
+  health_entropy_fn (ISSUE 15): when given (the buffer's
+  ``priority_entropy_fn``), the metrics additionally carry the fixed
+  health-summary pytree (obs/health.SUMMARY_KEYS) — non-finite counts
+  over grads/params/targets, grad/param norms, TD/Q mean/max, priority
+  entropy, sample age — computed IN-PROGRAM from values the body
+  already holds. The caller must then pass a health-instrumented
+  ``step_fn`` (Trainer.train_step_fn(with_health=True)) so the grad
+  reductions exist; the cost is a handful of scalar reductions inside
+  the same executable, zero new entries in any ledger.
   """
 
   def learn(train_state, buffer_state, target_variables, sample_key,
@@ -640,6 +677,26 @@ def make_learn_iteration_fn(model, step_fn, sample, update_priorities,
         "q_next": jnp.mean(q_next),
         "staleness": jnp.mean(staleness.astype(jnp.float32)),
     }
+    if health_entropy_fn is not None:
+      from tensor2robot_tpu.obs import health as health_lib
+      inner_metrics.update({
+          "health/nonfinite_grads":
+              metrics["grads_nonfinite"].astype(jnp.float32),
+          "health/nonfinite_params":
+              health_lib.tree_nonfinite_count(train_state.params),
+          "health/nonfinite_targets":
+              jnp.sum(~jnp.isfinite(targets)).astype(jnp.float32),
+          "health/grad_norm": metrics["grad_norm"].astype(jnp.float32),
+          "health/param_norm":
+              health_lib.tree_global_norm(train_state.params),
+          "health/td_mean": jnp.mean(td),
+          "health/td_max": jnp.max(td),
+          "health/q_mean": jnp.mean(q),
+          "health/q_max": jnp.max(q),
+          "health/priority_entropy": health_entropy_fn(buffer_state),
+          "health/sample_age":
+              jnp.mean(staleness.astype(jnp.float32)),
+      })
     return train_state, buffer_state, inner_metrics
 
   return learn
@@ -681,13 +738,22 @@ class MegastepLearner(TargetNetwork):
       polyak_tau: Optional[float] = None,
       ledger: Optional[obs_ledger.ExecutableLedger] = None,
       precision: str = "f32",
+      health: bool = False,
   ):
     """`precision` (ISSUE 13, cem.SCORING_PRECISIONS) is the Q-scoring
     tier of the fused label stage: the CEM target max inside the scan
     runs at the tier, while the train body's grads/optimizer and the
     fresh-params TD forward that drives priorities stay f32 (targets
     re-enter the learn body as float32). "f32" lowers the megastep
-    bit-identically to the pre-tier program."""
+    bit-identically to the pre-tier program.
+
+    `health` (ISSUE 15): the scanned learn body additionally computes
+    the fixed health-summary reductions (obs/health.SUMMARY_KEYS) —
+    non-finite counts, grad/param norms, TD/Q extrema, priority
+    entropy, sample age — aggregated across the K inner iterations
+    (running max for the spike-sensitive keys) and returned with the
+    metrics. Same ONE megastep executable; the summaries ride the
+    existing scalar D2H."""
     if inner_steps < 1:
       raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
     # Cold target net: the first refresh() hard-copies regardless of
@@ -707,6 +773,7 @@ class MegastepLearner(TargetNetwork):
     self._seed = seed
     self._clip_targets = getattr(model, "loss_type",
                                  "cross_entropy") == "cross_entropy"
+    self.health = bool(health)
     self.compile_counts: Dict[str, int] = {}
     self._ledger = ledger
     self._exec = None
@@ -717,7 +784,7 @@ class MegastepLearner(TargetNetwork):
 
   def _build_megastep_fn(self):
     model = self._model
-    step_fn = self._trainer.train_step_fn()
+    step_fn = self._trainer.train_step_fn(with_health=self.health)
     sample = self._buffer.sample_fn()
     update_priorities = self._buffer.update_priorities_fn()
     # THE shared target body (bellman.make_bellman_targets_fn): the
@@ -733,9 +800,11 @@ class MegastepLearner(TargetNetwork):
     sample_base = jax.random.key(self._seed)
     label_base = jax.random.key(self._seed + 1)
 
-    learn = make_learn_iteration_fn(model, step_fn, sample,
-                                    update_priorities, targets_fn,
-                                    target_key, clip)
+    learn = make_learn_iteration_fn(
+        model, step_fn, sample, update_priorities, targets_fn,
+        target_key, clip,
+        health_entropy_fn=(self._buffer.priority_entropy_fn()
+                           if self.health else None))
 
     def megastep(train_state, buffer_state, target_variables,
                  outer_step, label_seed0):
@@ -760,9 +829,13 @@ class MegastepLearner(TargetNetwork):
       (train_state, buffer_state), metrics = jax.lax.scan(
           body, (train_state, buffer_state),
           jnp.arange(k, dtype=jnp.int32))
-      # Host-loop convention: report the LAST inner step's metrics.
-      return train_state, buffer_state, jax.tree_util.tree_map(
-          lambda x: x[-1], metrics)
+      # Host-loop convention: report the LAST inner step's metrics —
+      # except the spike-sensitive health keys, which keep their MAX
+      # over the scan (a transient mid-scan NaN or norm spike must
+      # survive to the dispatch readout; obs/health.SCAN_MAX_KEYS).
+      from tensor2robot_tpu.obs import health as health_lib
+      return train_state, buffer_state, (
+          health_lib.reduce_scanned_metrics(metrics))
 
     return megastep
 

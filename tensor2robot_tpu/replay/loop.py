@@ -369,6 +369,23 @@ class ReplayLoopConfig:
   checkpoint_every: int = 0
   checkpoint_keep: int = 3
   resume: bool = False
+  # Training-health sentinel (ISSUE 15, obs/health.py). health=True
+  # (the default: unattended operation is the ROADMAP item 1 operating
+  # mode) computes the fixed per-learn-iteration health summary —
+  # non-finite counts over grads/params/targets, grad/param norms,
+  # TD/Q mean/max, priority entropy, sample age — IN-PROGRAM on the
+  # fused paths (zero new executables; the summaries ride the existing
+  # metrics D2H) and per optimizer step on the host path (one extra
+  # tiny `health_summary` executable), and runs every observation
+  # through a HealthMonitor with the default rules: breaches escalate
+  # registry counters -> a `health_breach` flightrec dump carrying the
+  # step -> (with checkpointing armed on the host path) an automatic
+  # checkpoint snapshot of the breaching state. health_halt=True
+  # additionally HALTS the loop (obs.health.HealthHalt) when a hard
+  # rule — non-finite grads/params/targets — breaches, rather than
+  # training on garbage.
+  health: bool = True
+  health_halt: bool = False
   # Windowed device-trace capture (ISSUE 11 satellite): (start, end)
   # OPTIMIZER steps handed to utils.profiling.ProfilerHook — the same
   # windowed jax.profiler capture train_eval runs, now available on
@@ -433,6 +450,18 @@ class ReplayTrainLoop:
     self.recorder = flight_recorder or flight_lib.FlightRecorder(
         dump_dir=logdir)
     self.watchdog = watchdog or watchdog_lib.get_watchdog()
+    # Training-health sentinel (ISSUE 15): one monitor per loop,
+    # escalating through THIS loop's recorder (dumps land in the
+    # logdir beside the metrics) and the process registry.
+    self.health_monitor = None
+    if config.health:
+      from tensor2robot_tpu.obs import health as health_lib
+      self.health_monitor = health_lib.HealthMonitor(
+          rules=health_lib.default_rules(capacity=config.capacity),
+          registry=self.registry, recorder=self.recorder,
+          halt_on_breach=config.health_halt)
+    self._health_exec = None
+    self._pending_numeric: List[faults_lib.FaultSpec] = []
     mesh = None
     if config.mesh_dp:
       import jax
@@ -684,6 +713,48 @@ class ReplayTrainLoop:
     else:
       hook.after_step(shim, {})
 
+  def _host_param_health(self, state) -> Dict[str, float]:
+    """Params' non-finite count + global norm for the host path's
+    health summary — ONE tiny AOT executable (`health_summary` in the
+    ledger), compiled once at the params' fixed avals. The fused paths
+    compute the same reductions INSIDE their one executable instead."""
+    import jax
+
+    from tensor2robot_tpu.obs import health as health_lib
+    if self._health_exec is None:
+      def param_health(params):
+        return (health_lib.tree_nonfinite_count(params),
+                health_lib.tree_global_norm(params))
+
+      self._health_exec = jax.jit(param_health).lower(
+          state.params).compile()
+      self.compile_counts["health_summary"] = (
+          self.compile_counts.get("health_summary", 0) + 1)
+      self.obs_ledger.register("health_summary",
+                               compiled=self._health_exec)
+    start = time.perf_counter()
+    nonfinite, norm = jax.device_get(self._health_exec(state.params))
+    self.obs_ledger.record_dispatch("health_summary",
+                                    time.perf_counter() - start)
+    return {"health/nonfinite_params": float(nonfinite),
+            "health/param_norm": float(norm)}
+
+  def _observe_health(self, step: int, summary: Dict[str, float],
+                      snapshot_fn=None) -> None:
+    """One summary through the monitor (no-op without one). Raises
+    HealthHalt under config.health_halt — the caller's loop body lets
+    it propagate through the normal shutdown path."""
+    if self.health_monitor is None or not summary:
+      return
+    self.health_monitor.observe_with_snapshot(step, summary,
+                                              snapshot_fn=snapshot_fn)
+
+  def _fused_health_summary(self, metrics: Dict[str, float]
+                            ) -> Dict[str, float]:
+    """The health keys out of a fused dispatch's host metrics."""
+    return {key: value for key, value in metrics.items()
+            if key.startswith("health/")}
+
   def _obs_block(self) -> Dict:
     """Per-executable device-time attribution over this run's window."""
     import jax
@@ -703,6 +774,8 @@ class ReplayTrainLoop:
                        / max(initial_eval["eval_td_error"], 1e-9))
     return {
         "obs": self._obs_block(),
+        "health": (self.health_monitor.snapshot()
+                   if self.health_monitor is not None else None),
         "steps": steps,
         "initial_eval": initial_eval,
         "final_eval": {key: v for key, v in final_eval.items()
@@ -907,6 +980,15 @@ class ReplayTrainLoop:
         self._feeder_hb.beat()
         batch, info = self.buffer.sample()
         targets, q_next = updater.compute_targets(batch)
+        # Numeric fault seam, apply half (ISSUE 15): specs returned by
+        # the previous step's perturb corrupt THIS step's labels —
+        # nan_grads poisons one target (the real backward then
+        # produces genuinely non-finite grads), value_scale explodes
+        # them finitely. Detection is the health monitor's job below.
+        if self._pending_numeric:
+          targets = faults_lib.apply_numeric_to_targets(
+              targets, self._pending_numeric)
+          self._pending_numeric = []
         features = {"image": np.asarray(batch["image"]),
                     "action": np.asarray(batch["action"])}
         labels = {"target_q": targets}
@@ -915,7 +997,9 @@ class ReplayTrainLoop:
           # AOT once at the buffer's fixed shape: any later shape drift
           # raises inside XLA's executable check instead of recompiling
           # — this plus the ledger IS the "compiles exactly once" claim.
-          train_step = self.trainer.aot_train_step(state, *sharded)
+          train_step = self.trainer.aot_train_step(
+              state, *sharded,
+              with_health=self.health_monitor is not None)
           self.compile_counts["train_step"] = (
               self.compile_counts.get("train_step", 0) + 1)
           self.obs_ledger.register(
@@ -933,6 +1017,37 @@ class ReplayTrainLoop:
         td = updater.td_errors(online, batch, targets)
         self.buffer.update_priorities(info.indices, td)
         self._profile_step(profile_hook, step)
+
+        if self.health_monitor is not None:
+          # The host loop's form of the fixed summary (the fused paths
+          # compute the same keys in-program): grad stats ride the
+          # health-instrumented train step's metrics, param stats the
+          # one-off health_summary executable, the rest is host data
+          # this step already produced. q here is the Bellman
+          # bootstrap Q (q_next) — the value stream whose explosion
+          # the drift rule watches on this path.
+          summary = {
+              "health/nonfinite_grads": float(metrics["grads_nonfinite"]),
+              "health/grad_norm": float(metrics["grad_norm"]),
+              "health/nonfinite_targets": float(
+                  np.sum(~np.isfinite(np.asarray(targets)))),
+              "health/td_mean": float(np.mean(td)),
+              "health/td_max": float(np.max(td)),
+              "health/q_mean": float(np.mean(q_next)),
+              "health/q_max": float(np.max(q_next)),
+              "health/priority_entropy": float(
+                  self.buffer.priority_entropy()),
+              "health/sample_age": float(np.mean(info.staleness)),
+              **self._host_param_health(state),
+          }
+          snapshot_fn = None
+          if self._ckpt_manager is not None and c.checkpoint_every:
+            # The auto-action: freeze the breaching state with the
+            # PR 11 checkpoint machinery before any halt, so the
+            # post-mortem has the exact params that went bad.
+            snapshot_fn = lambda: self._save_checkpoint(  # noqa: E731
+                step, state, updater, initial_eval, eval_history)
+          self._observe_health(step, summary, snapshot_fn=snapshot_fn)
 
         if step % c.refresh_every == 0:
           # The hot-reload path: collectors and the target net pull the
@@ -954,6 +1069,11 @@ class ReplayTrainLoop:
               **self.feeder.metrics(),
           }
           self._emit(step, final_metrics)
+          if self.health_monitor is not None:
+            # The health block rides its own registry-bridged flush
+            # (a separate JSONL record: the replay/ records keep their
+            # pre-health schema byte-for-byte).
+            self._emit(step, dict(self.health_monitor.last_summary))
         if step % c.eval_every == 0 or step == num_steps:
           with trace_lib.span("replay/eval"):
             evals = self._eval(updater, online, eval_batches,
@@ -968,10 +1088,12 @@ class ReplayTrainLoop:
         # HERE, between optimizer steps — after any checkpoint this
         # step owed, exactly where a preemption would land. The raise
         # propagates through run()'s flightrec wrap; collectors shut
-        # down via the finally below.
+        # down via the finally below. Numeric kinds (ISSUE 15) return
+        # instead of raising and corrupt the NEXT step's targets.
         if self._faults is not None:
-          self._faults.perturb("learner_step", site="learner",
-                               index=step)
+          self._pending_numeric.extend(
+              self._faults.perturb("learner_step", site="learner",
+                                   index=step))
     finally:
       self._profile_step(profile_hook, num_steps, final=True)
       collector_errors = self._shutdown_collectors()
@@ -1026,7 +1148,8 @@ class ReplayTrainLoop:
         num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
         iterations=c.cem_iterations, inner_steps=k, seed=c.seed + 13,
         polyak_tau=c.polyak_tau, ledger=self.obs_ledger,
-        precision=c.precision)
+        precision=c.precision,
+        health=self.health_monitor is not None)
     # Cold-start target = initial online copy (BellmanUpdater parity);
     # this counts as refresh 0, not a loop refresh.
     learner.refresh(host_variables, step=0)
@@ -1054,6 +1177,20 @@ class ReplayTrainLoop:
         self._learner_hb.beat()
         step = outer * k
         self._profile_step(profile_hook, step)
+        # In-program health summaries (ISSUE 15): the fused dispatch
+        # already carried them back with the metrics — one observe per
+        # dispatch, covering the K scanned iterations (spike keys are
+        # scan-maxed inside the program).
+        self._observe_health(step, self._fused_health_summary(metrics))
+        # Numeric fault seam (ISSUE 15): corruption lands on the
+        # carried params between dispatches — where a preemption-era
+        # memory fault would. The NEXT dispatch's in-program summary
+        # must detect it.
+        if self._faults is not None:
+          numeric = self._faults.perturb("learner_step",
+                                         site="megastep", index=step)
+          if numeric:
+            state = faults_lib.corrupt_train_state(state, numeric)
         # Cadences count OPTIMIZER steps: an event fires when its
         # multiple falls inside this megastep's [prev_step+1, step].
         crossed = lambda every: (step // every) > (prev_step // every)
@@ -1076,6 +1213,8 @@ class ReplayTrainLoop:
               **self.feeder.metrics(),
           }
           self._emit(step, final_metrics)
+          if self.health_monitor is not None:
+            self._emit(step, dict(self.health_monitor.last_summary))
         if crossed(c.eval_every) or outer == num_outer:
           # Valid until the NEXT megastep donates the state away.
           online = state.variables(use_ema=True)
@@ -1149,7 +1288,8 @@ class ReplayTrainLoop:
         exploration_epsilon=c.exploration_epsilon,
         scripted_fraction=c.scripted_fraction, seed=c.seed + 13,
         polyak_tau=c.polyak_tau, ledger=self.obs_ledger,
-        precision=c.precision)
+        precision=c.precision,
+        health=self.health_monitor is not None)
     loop.refresh(host_variables, step=0)
     profile_hook = self._profile_hook()
 
@@ -1181,6 +1321,19 @@ class ReplayTrainLoop:
         dispatches += 1
         step = loop.trained_steps
         self._profile_step(profile_hook, step)
+        # In-program health summaries (ISSUE 15): observed only when
+        # the dispatch actually trained (a warm-up dispatch's summary
+        # is the zero placeholder, not evidence).
+        if metrics.get("trained_steps"):
+          self._observe_health(step,
+                               self._fused_health_summary(metrics))
+        # Numeric fault seam (ISSUE 15): between-dispatch param
+        # corruption, same placement as the megastep path's.
+        if self._faults is not None:
+          numeric = self._faults.perturb("learner_step", site="anakin",
+                                         index=step)
+          if numeric:
+            state = faults_lib.corrupt_train_state(state, numeric)
         crossed = lambda every: (step // every) > (prev_step // every)
         done = step >= num_steps
 
@@ -1199,6 +1352,8 @@ class ReplayTrainLoop:
               "replay/env_steps": float(loop.env_steps),
               **self.buffer.metrics(),
           })
+          if self.health_monitor is not None:
+            self._emit(step, dict(self.health_monitor.last_summary))
         if crossed(c.eval_every) or done:
           # Valid until the NEXT dispatch donates the state away.
           online = state.variables(use_ema=True)

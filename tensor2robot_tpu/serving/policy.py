@@ -120,12 +120,19 @@ class CEMFleetPolicy:
 
   def __call__(self, images: Sequence[np.ndarray],
                seeds: Optional[Sequence[int]] = None, *,
-               variables=None) -> np.ndarray:
+               variables=None,
+               return_scores: bool = False) -> np.ndarray:
     """Control step for `images`. `variables` overrides the predictor's
     live params THROUGH THE SAME compiled executables (params are an
     argument, never baked in) — the rollout controller's shadow path
     scores a candidate checkpoint on this replica's device without
-    adding a single entry to the compile ledger."""
+    adding a single entry to the compile ledger.
+
+    return_scores=True (ISSUE 15) additionally returns the selected
+    actions' Q-scores as ``(actions, scores)`` — the bucket executable
+    already computes them (CEM's final elite-mean score), so the fleet
+    Q-drift sketches cost zero extra device work. The host fallback
+    has no per-call score readout and returns ``(actions, None)``."""
     batch = np.stack([np.asarray(image) for image in images])
     n = batch.shape[0]
     seeds = (self.assign_seeds(n) if seeds is None
@@ -140,7 +147,8 @@ class CEMFleetPolicy:
             "variables override requires the predictor's device path "
             "(the host fallback scores through predictor.predict, whose "
             "params cannot be swapped per call).")
-      return self._host_call(batch, seeds)
+      actions = self._host_call(batch, seeds)
+      return (actions, None) if return_scores else actions
     variables = self._place(
         live_variables if variables is None else variables)
     padded, bucket = self.ladder.pad_batch(batch)
@@ -148,17 +156,24 @@ class CEMFleetPolicy:
     compiled = self._executable_for(bucket, fn, variables, padded,
                                     padded_seeds)
     if self._ledger is None:
-      actions = compiled(variables, self._put(padded),
-                         self._put(padded_seeds))
-      return np.asarray(actions)[:n]
+      actions, scores = compiled(variables, self._put(padded),
+                                 self._put(padded_seeds))
+      actions = np.asarray(actions)[:n]
+      if return_scores:
+        return actions, np.asarray(scores)[:n]
+      return actions
     # Ledger path: the host→numpy conversion below synchronizes on the
     # result, so the measured window is dispatch through completion.
     start = time.perf_counter()
-    actions = np.asarray(compiled(variables, self._put(padded),
-                                  self._put(padded_seeds)))
+    actions, scores = compiled(variables, self._put(padded),
+                               self._put(padded_seeds))
+    actions = np.asarray(actions)[:n]
+    scores = np.asarray(scores)[:n]
     self._ledger.record_dispatch(self._ledger_key(bucket),
                                  time.perf_counter() - start)
-    return actions[:n]
+    if return_scores:
+      return actions, scores
+    return actions
 
   def _ledger_key(self, bucket: int) -> str:
     tier = f"_{self.precision}" if self.precision != "f32" else ""
@@ -198,7 +213,11 @@ class CEMFleetPolicy:
   # -- compiled path -------------------------------------------------------
 
   def _build_control(self, fn):
-    """(variables, (B,...) images, (B,) seeds) → (B, A) actions."""
+    """(variables, (B,...) images, (B,) seeds) → ((B, A) actions,
+    (B,) selected-action Q-scores). The scores are CEM's own final
+    readout — already computed inside the search — returned so the
+    serving layer's per-replica Q sketches (the fleet drift guard,
+    ISSUE 15) ride the same dispatch instead of a second forward."""
     num_samples = self._num_samples
 
     def control(variables, images, seeds):
@@ -215,11 +234,11 @@ class CEMFleetPolicy:
       score = cem.make_tiled_q_score_fn(fn, variables,
                                         precision=self.precision)
 
-      best, _ = cem.fleet_cem_optimize(
+      best, best_scores = cem.fleet_cem_optimize(
           score, images, keys, self._action_size,
           num_samples=num_samples, num_elites=self._num_elites,
           iterations=self._iterations, precision=self.precision)
-      return best
+      return best, best_scores
 
     return control
 

@@ -58,7 +58,16 @@ class PolicyReplica:
                restart_budget: int = 3):
     self.policy = policy
     self.device = policy.device
+    self.stats = stats
     self._faults = fault_plan
+    # corrupt_served_variables state (ISSUE 15): once the fault fires,
+    # the replica serves a finite-but-wrong scaled copy of the live
+    # params — STICKY, like the botched hot-swap it models — until the
+    # Q-drift guard catches it. Cache keyed on the live tree identity
+    # so a hot reload re-corrupts the NEW params (still corrupted, one
+    # scale job per reload).
+    self._corrupt_scale: Optional[float] = None
+    self._corrupt_cache = None
     self.batcher = MicroBatcher(
         self._flush, max_batch=max_batch, deadline_ms=deadline_ms,
         stats=stats, bucket_for=policy.ladder.bucket_for,
@@ -81,6 +90,17 @@ class PolicyReplica:
           f"{self.device}")
     self.policy = policy
 
+  def _corrupted_variables(self):
+    """The sticky corrupt_served_variables tree for the CURRENT live
+    params (rebuilt after a hot reload; the scaled copy flows through
+    the policy's identity-keyed placement cache like any candidate)."""
+    _, live = self.policy._predictor.device_fn()
+    if self._corrupt_cache is not None and self._corrupt_cache[0] is live:
+      return self._corrupt_cache[1]
+    corrupted = faults_lib.corrupt_variables(live, self._corrupt_scale)
+    self._corrupt_cache = (live, corrupted)
+    return corrupted
+
   def _flush(self, items):
     images = [item[0] for item in items]
     seeds = np.asarray([item[1] for item in items], np.uint32)
@@ -90,14 +110,33 @@ class PolicyReplica:
     # actually landed on.
     with trace_lib.span("serve/dispatch", batch=len(items),
                         device=str(self.device)):
-      # Fault seam (ISSUE 14): the ONE point a scheduled
+      # Fault seam (ISSUE 14/15): the ONE point a scheduled
       # dispatch_error / latency_spike enters this replica — inside
       # the dispatch span, so the injected fault's flight-recorder
       # dump carries the batch's request_ids, and upstream sees
       # exactly what a real device failure produces (a raising flush).
+      # A fired corrupt_served_variables spec (returned, not raised)
+      # installs the sticky scaled-params corruption the fleet
+      # Q-drift guard must detect.
       if self._faults is not None:
-        self._faults.perturb("replica_dispatch", site=str(self.device))
-      return list(self.policy(images, seeds))
+        for spec in self._faults.perturb("replica_dispatch",
+                                         site=str(self.device)):
+          if spec.kind == "corrupt_served_variables":
+            self._corrupt_scale = spec.scale
+            self._corrupt_cache = None
+      override = (self._corrupted_variables()
+                  if self._corrupt_scale is not None else None)
+      actions, scores = self.policy(images, seeds, variables=override,
+                                    return_scores=True)
+      if scores is not None:
+        # Served-Q sketch feed (ISSUE 15): free scores off the same
+        # dispatch; exception-isolated — diagnostics never fail a
+        # flush (the listener contract).
+        try:
+          self.stats.record_q_values(str(self.device), scores)
+        except Exception:
+          pass
+      return list(actions)
 
   def warmup(self, make_image) -> None:
     """Compiles the full ladder on this replica's device (server
@@ -213,6 +252,10 @@ class FleetRouter:
     self._health_events = []
     self._max_health_events = 1024
     self._degraded = False
+    # Fleet Q-drift guard state (ISSUE 15): replicas currently flagged
+    # divergent — transitions (not steady states) fire the
+    # replica_divergent flightrec trigger and the timeline event.
+    self._divergent_replicas = set()
     self._started_at = time.perf_counter()
     self.replicas = []
     self._breakers = []
@@ -341,6 +384,7 @@ class FleetRouter:
     ledger forbids mid-run."""
     self.stats = stats
     for replica in self.replicas:
+      replica.stats = stats
       replica.batcher.use_stats(stats)
 
   # -- client API ----------------------------------------------------------
@@ -609,11 +653,60 @@ class FleetRouter:
     except Exception:
       pass
 
+  def check_q_drift(self) -> dict:
+    """The fleet Q-drift guard (ISSUE 15): per-replica served-Q sketch
+    medians vs the fleet median (obs/health.q_drift_report under the
+    HealthConfig thresholds). A replica turning divergent fires the
+    ``replica_divergent`` flightrec trigger, bumps
+    ``health/replica_divergent``, and lands a timeline event; one
+    recovering (after a fixing hot-swap refilled its sketch) lands a
+    ``replica_converged`` event. This is the check that catches a
+    corrupted replica or a botched ``set_variables`` that still
+    returns finite numbers — no breaker trips, nothing raises, only
+    the served VALUES are wrong."""
+    from tensor2robot_tpu.obs import health as health_lib
+
+    report = health_lib.q_drift_report(
+        self.stats.q_sketch_summaries(),
+        z_threshold=self.health.q_drift_z,
+        min_samples=self.health.q_drift_min_samples,
+        min_scale=self.health.q_drift_min_scale)
+    divergent = set(report["divergent"])
+    index_of = {str(replica.device): i
+                for i, replica in enumerate(self.replicas)}
+    with self._health_lock:
+      newly = sorted(divergent - self._divergent_replicas)
+      recovered = sorted(self._divergent_replicas - divergent)
+      self._divergent_replicas = divergent
+      for name in newly:
+        self._health_event("replica_divergent", index_of.get(name),
+                           delta=report["replicas"][name].get("delta"))
+      for name in recovered:
+        self._health_event("replica_converged", index_of.get(name))
+    for name in newly:
+      try:
+        from tensor2robot_tpu.obs import registry as registry_lib
+        registry_lib.get_registry().counter(
+            "health/replica_divergent").inc()
+      except Exception:
+        pass
+      try:
+        self._recorder.trigger(
+            "replica_divergent", replica=name,
+            delta=report["replicas"][name].get("delta"),
+            fleet_median=report.get("fleet_median"))
+      except Exception:
+        pass
+    return report
+
   def health_snapshot(self) -> dict:
     """Per-replica breaker states + the transition timeline — the
-    chaos artifact's quarantine→probe→reinstate evidence."""
+    chaos artifact's quarantine→probe→reinstate evidence — plus the
+    fleet Q-drift verdict (``health`` rolls up to "ok" only when no
+    breaker is open AND no replica serves divergent Q-values)."""
+    q_drift = self.check_q_drift()
     with self._health_lock:
-      return {
+      snapshot = {
           "replicas": {
               str(replica.device): {
                   "state": breaker.state,
@@ -625,8 +718,15 @@ class FleetRouter:
               for replica, breaker in zip(self.replicas, self._breakers)
           },
           "degraded": self._degraded,
+          "q_drift": q_drift,
           "timeline": [dict(entry) for entry in self._health_events],
       }
+    all_closed = all(entry["state"] == "closed"
+                     for entry in snapshot["replicas"].values())
+    snapshot["health"] = (
+        "ok" if all_closed and q_drift["verdict"] != "divergent"
+        else "degraded")
+    return snapshot
 
   def act(self, image, slo: Optional[SLOClass] = None,
           timeout: Optional[float] = None) -> np.ndarray:

@@ -138,6 +138,17 @@ class HealthConfig:
   retry_cost_ms: float = 50.0
   max_retries: int = 2
   restart_budget: int = 3
+  # Fleet Q-drift guard (ISSUE 15, obs/health.q_drift_report): a
+  # replica with at least q_drift_min_samples served values whose
+  # sketch mean sits more than q_drift_z robust deviations
+  # (leave-one-out median/MAD, floored by the fleet's within-replica
+  # spread and q_drift_min_scale) from the rest of the fleet is
+  # DIVERGENT — a corrupted replica or botched hot-swap that still
+  # returns finite numbers. Scale-free: works unchanged across Q heads
+  # whose score spaces differ by orders of magnitude.
+  q_drift_z: float = 8.0
+  q_drift_min_samples: int = 16
+  q_drift_min_scale: float = 1e-4
 
 
 class CircuitBreaker:

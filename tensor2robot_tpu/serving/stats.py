@@ -64,6 +64,57 @@ class LatencyHistogram:
     }
 
 
+class QSketch:
+  """Streaming quantile sketch of one replica's SERVED Q-values.
+
+  A bounded reservoir (newest ``max_samples``) for the statistics plus
+  an exact lifetime count — the per-replica input of the fleet Q-drift
+  guard (obs/health.q_drift_report): every replica serves the same
+  request distribution through the same params, so the sketches must
+  agree; one that doesn't is serving a different function (a corrupted
+  replica or a botched hot-swap that still returns finite numbers).
+  Every statistic except ``count`` is computed over the RETAINED
+  reservoir — the sketch describes what the replica serves NOW, so a
+  corrective hot-swap lets a once-divergent replica read healthy again
+  once fresh traffic refills the window (and the router-side guard
+  agrees with the aggregator, which only ever sees the exported
+  reservoir). ``count`` stays lifetime: it gates on evidence volume.
+  """
+
+  __slots__ = ("_samples", "_count", "_lock")
+
+  def __init__(self, max_samples: int = 4096):
+    self._samples: collections.deque = collections.deque(
+        maxlen=max_samples)
+    self._count = 0
+    self._lock = threading.Lock()
+
+  def record_many(self, values) -> None:
+    with self._lock:
+      for value in values:
+        self._samples.append(float(value))
+        self._count += 1
+
+  def summary(self, digits: int = 6) -> Dict[str, float]:
+    """{count, p50, p90, mean, min, max} — p-quantiles by the repo's
+    one nearest-rank convention; all but ``count`` over the retained
+    reservoir (see class docstring)."""
+    with self._lock:
+      samples = list(self._samples)
+      count = self._count
+    if not samples:
+      return {"count": 0, "p50": None}
+    ordered = sorted(samples)
+    return {
+        "count": count,
+        "p50": round(_nearest_rank(ordered, 50), digits),
+        "p90": round(_nearest_rank(ordered, 90), digits),
+        "mean": round(sum(samples) / len(samples), digits),
+        "min": round(ordered[0], digits),
+        "max": round(ordered[-1], digits),
+    }
+
+
 class _ClassStats:
   """Per-SLO-class counters (guarded by the owning ServingStats lock)."""
 
@@ -101,6 +152,7 @@ class ServingStats:
     self._deadline_flushes = 0  # flushed by deadline, not by a full batch
     self._queue_depth_sum = 0   # queue depth left behind at flush time
     self._per_class: Dict[str, _ClassStats] = {}
+    self._q_sketches: Dict[str, QSketch] = {}
 
   def _class(self, class_name: Optional[str]) -> Optional[_ClassStats]:
     """Lazily creates the class bucket; caller holds the lock."""
@@ -144,6 +196,29 @@ class ServingStats:
     self._registry.counter(f"serving/shed_{reason}").inc()
     self._registry.counter(
         f"serving/class/{class_name or 'default'}/shed_{reason}").inc()
+
+  def record_q_values(self, replica: str, values) -> None:
+    """Served Q-scores from one replica dispatch (ISSUE 15): feeds the
+    per-replica streaming sketch AND the registry histogram
+    ``serving/replica/<replica>/q_value`` — the reservoir the fleet
+    aggregator unions, so the Q-drift check runs cross-process through
+    the same snapshot machinery every other metric rides."""
+    with self._lock:
+      sketch = self._q_sketches.get(replica)
+      if sketch is None:
+        sketch = self._q_sketches[replica] = QSketch()
+    sketch.record_many(values)
+    hist = self._registry.histogram(
+        f"serving/replica/{replica}/q_value")
+    for value in values:
+      hist.record(float(value))
+
+  def q_sketch_summaries(self) -> Dict[str, Dict[str, float]]:
+    """{replica: sketch summary} — the Q-drift guard's input."""
+    with self._lock:
+      sketches = dict(self._q_sketches)
+    return {replica: sketch.summary()
+            for replica, sketch in sorted(sketches.items())}
 
   def record_flush(self, batch_size: int, bucket: int,
                    queue_depth_after: int, deadline_expired: bool) -> None:
@@ -200,6 +275,9 @@ class ServingStats:
       out["latency_" + key if not key.startswith("count") else
           "latency_samples"] = value
     out["per_class"] = per_class
+    q_sketches = self.q_sketch_summaries()
+    if q_sketches:
+      out["q_sketches"] = q_sketches
     return out
 
   @staticmethod

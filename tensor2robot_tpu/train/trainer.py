@@ -82,6 +82,7 @@ class Trainer:
     self._batch_sharding = mesh_lib.batch_sharding(self.mesh, data_axis)
     self._replicated = mesh_lib.replicated_sharding(self.mesh)
     self._train_step = None
+    self._train_step_health = None
     self._train_steps = None
     self._train_step_accum = None
     self._eval_step = None
@@ -189,9 +190,17 @@ class Trainer:
         opt_state=new_opt_state,
         ema_params=new_ema)
 
-  def _make_train_step_fn(self):
+  def _make_train_step_fn(self, with_health: bool = False):
     """The uncompiled (state, features, labels) -> (state', metrics) body
-    shared by the single-step and scanned multi-step compilations."""
+    shared by the single-step and scanned multi-step compilations.
+
+    with_health (ISSUE 15): the metrics dict additionally carries
+    ``grad_norm`` (global L2) and ``grads_nonfinite`` (non-finite
+    element count) computed from the RAW gradients before the
+    optimizer apply — the two reductions the health sentinel cannot
+    reconstruct after the fact (a clipped/NaN-propagated param delta
+    is not the gradient). A few extra reductions inside the same
+    compiled step; the training math is untouched."""
     model = self.model
     base_rng = self._base_rng
 
@@ -207,6 +216,12 @@ class Trainer:
 
       grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
       (_, (metrics, new_model_state)), grads = grad_fn(state.params)
+      if with_health:
+        from tensor2robot_tpu.obs import health as health_lib
+        metrics = dict(metrics)
+        metrics["grad_norm"] = health_lib.tree_global_norm(grads)
+        metrics["grads_nonfinite"] = health_lib.tree_nonfinite_count(
+            grads)
       return self._apply_grads(state, grads, new_model_state), metrics
 
     return step_fn
@@ -255,8 +270,8 @@ class Trainer:
 
     return accum_fn
 
-  def _build_train_step(self):
-    step_fn = self._make_train_step_fn()
+  def _build_train_step(self, with_health: bool = False):
+    step_fn = self._make_train_step_fn(with_health=with_health)
     if self._pure_dp:
       return jax.jit(
           step_fn,
@@ -323,7 +338,7 @@ class Trainer:
 
   # --- public API ----------------------------------------------------------
 
-  def train_step_fn(self):
+  def train_step_fn(self, with_health: bool = False):
     """The UNCOMPILED (state, features, labels) -> (state', metrics) body.
 
     For fused consumers that inline the optimizer step into a larger
@@ -331,8 +346,10 @@ class Trainer:
     times inside one donated executable). Callers own compilation;
     the body carries the trainer's RNG fold-from-step discipline, so a
     scan over it replays the identical randomness stream as K separate
-    `train_step` calls."""
-    return self._make_train_step_fn()
+    `train_step` calls. ``with_health`` adds the grad_norm /
+    grads_nonfinite reductions to the metrics (see
+    _make_train_step_fn) — the fused health summaries ride them."""
+    return self._make_train_step_fn(with_health=with_health)
 
   def train_step(self, state: TrainState, features, labels=None
                  ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
@@ -351,14 +368,24 @@ class Trainer:
       self._train_steps = self._build_train_steps()
     return self._train_steps(state, features, labels)
 
-  def aot_train_step(self, state: TrainState, features, labels=None):
+  def aot_train_step(self, state: TrainState, features, labels=None,
+                     with_health: bool = False):
     """AOT-lowered+compiled SINGLE train step for the same arguments.
 
     The replay loop's recompile ledger hangs on this: an AOT executable
     rejects any later shape/dtype drift instead of silently retracing,
     turning "the fixed-shape sampler never recompiles the train step"
     from a hope into an enforced invariant. Shares `train_step`'s
-    donation semantics (pass back the state it returns)."""
+    donation semantics (pass back the state it returns).
+    ``with_health`` compiles the health-instrumented body (grad_norm /
+    grads_nonfinite in the metrics) — cached separately so the plain
+    step is untouched for callers that never opt in."""
+    if with_health:
+      if self._train_step_health is None:
+        self._train_step_health = self._build_train_step(
+            with_health=True)
+      return self._train_step_health.lower(state, features,
+                                           labels).compile()
     if self._train_step is None:
       self._train_step = self._build_train_step()
     return self._train_step.lower(state, features, labels).compile()
