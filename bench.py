@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 16
+ROUND = 17
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -1092,6 +1092,29 @@ def _bench_health_compact():
       fleet_requests=120, control_steps=15, enforce_bars=False)
 
 
+def _bench_tpquant_compact():
+  """TP + int8 block for the bench detail (ISSUE 16).
+
+  The committed chipless artifact (TPQUANT_r17.json) carries the full
+  protocol — the flagship conv tower through ONE fused anakin_step at
+  tp=1/2/4/8 with rule-derived partition specs (leaf shardings and
+  per-replica bytes asserted, tp=1 the bitwise oracle), the int8
+  served-weights tier's q-oracle agreement + per-tier ledger + >= 3x
+  served-bytes reduction, and the int8 promotion gate with an
+  injected-breach auto-rollback — where every RATE carries the
+  virtual-mesh caveat. This block is the driver-refreshable real-chip
+  counterpart: a reduced ladder on the window's devices, where
+  tp_scaling_efficiency becomes a measured chip number instead of the
+  chipless null.
+  """
+  from tensor2robot_tpu.replay.tpquant_bench import measure_tpquant
+  return measure_tpquant(
+      tp_ladder=(1, 2, 4), ladder_steps=2, buckets=(1, 4),
+      corpus_scenes=32, pretrain_steps=150, rollout_devices=None,
+      rollout_min_shadow=6, rollout_min_canary=3,
+      rollout_cycle_s=60.0, enforce_bars=False)
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1268,6 +1291,11 @@ def main() -> None:
   except Exception as e:
     health = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    tpquant = _bench_tpquant_compact()
+  except Exception as e:
+    tpquant = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1331,6 +1359,7 @@ def main() -> None:
       "precision": precision,
       "faults": faults,
       "health": health,
+      "tpquant": tpquant,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1407,6 +1436,17 @@ def main() -> None:
       "health_breach_detection_ok": health.get(
           "health_breach_detection_ok"),
       "fleet_q_drift_ok": health.get("fleet_q_drift_ok"),
+      # TP + int8 sentinels (ISSUE 16): the flagship TP ladder's
+      # measured scaling efficiency (a CHIP claim: null on a virtual
+      # mesh by the block's own honesty rule, measured on a real
+      # window), the int8 tier's selected-action q-agreement vs the
+      # f32 oracle (numerics — meaningful on any backend), and the
+      # flagship tree's int8 served-bytes reduction (structural).
+      # Null-safe under outage/error like every compact key.
+      "tp_scaling_efficiency": tpquant.get("tp_scaling_efficiency"),
+      "int8_q_agreement": tpquant.get("int8_q_agreement"),
+      "int8_param_bytes_reduction": tpquant.get(
+          "int8_param_bytes_reduction"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,
   }))

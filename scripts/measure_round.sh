@@ -285,6 +285,27 @@ else
     python -m tensor2robot_tpu.obs.health_bench --smoke \
       --out "$STAGE_TMP"'
 fi
+# Ninth chipless backstop (ISSUE 16): the TP + int8 protocol — the
+# flagship critic through ONE fused anakin_step at tp=1/2/4/8 with
+# rule-derived partition specs (sharding structure and per-replica
+# bytes asserted; tp=1 the bitwise oracle), the int8 served-weights
+# tier's q-oracle agreement / per-tier ledger / served-bytes
+# reduction, and the int8 promotion gate with an injected-breach
+# auto-rollback. Same tmp→mv atomicity and pytest deferral rules (its
+# ladder step rates are timing measurements; flagship compiles are
+# CPU-heavy).
+if [ -s "TPQUANT_${RTAG}.json" ]; then
+  log "skip TPQUANT_${RTAG}.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring tpquant backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "TPQUANT_${RTAG}.json" 3000 sh -c '
+    python -m tensor2robot_tpu.replay.tpquant_bench --smoke \
+      --out "$STAGE_TMP"'
+fi
 while [ "$(date +%s)" -lt "$deadline" ]; do
   # Never perturb a live test run: the probe's jax import is real CPU
   # on a small host, and the serving smoke's amortization bar is a

@@ -427,6 +427,38 @@ class HealthMonitor:
       except Exception:
         pass
 
+  def state_dict(self) -> dict:
+    """JSON-able resume state (ISSUE 16 satellite): the EWMA drift
+    baselines plus the per-rule seen counts — exactly the state whose
+    loss makes a resumed loop drift-blind for ``warmup`` steps. Breach
+    history/last_summary stay run-local (the flight recorder owns the
+    post-mortem record); hard rules carry no state at all."""
+    with self._lock:
+      return {
+          "drift": {name: [state.n, state.mean, state.var]
+                    for name, state in self._drift.items()},
+          "seen": dict(self._seen),
+          "observations": self.observations,
+      }
+
+  def load_state_dict(self, state: Mapping) -> None:
+    """Re-seats state_dict() baselines. Rule names the current monitor
+    doesn't know are ignored (a resume across a rule-set change keeps
+    what still applies); unknown-to-the-checkpoint rules keep their
+    fresh zero state and re-warm normally."""
+    with self._lock:
+      for name, entry in dict(state.get("drift", {})).items():
+        drift = self._drift.get(name)
+        if drift is None:
+          continue
+        drift.n, drift.mean, drift.var = (
+            int(entry[0]), float(entry[1]), float(entry[2]))
+      for name, count in dict(state.get("seen", {})).items():
+        if name in self._seen:
+          self._seen[name] = int(count)
+      self.observations = int(state.get("observations",
+                                        self.observations))
+
   def snapshot(self) -> dict:
     """Artifact-ready monitor state: rule table, breach history,
     per-rule counts, the last summary observed."""

@@ -9,7 +9,8 @@ reference had no TP at all; this is capability beyond it.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import re
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -40,6 +41,115 @@ def infer_dense_tp_specs(
     return PartitionSpec()
 
   return jax.tree_util.tree_map(rule, params)
+
+
+def path_key(path, sep: str = "/") -> str:
+  """Slash-joined name of a pytree key path (flax param naming):
+  ``(DictKey('pre_conv0'), DictKey('kernel'))`` → ``pre_conv0/kernel``."""
+  parts = []
+  for entry in path:
+    if hasattr(entry, "key"):
+      parts.append(str(entry.key))
+    elif hasattr(entry, "idx"):
+      parts.append(str(entry.idx))
+    elif hasattr(entry, "name"):
+      parts.append(str(entry.name))
+    else:
+      parts.append(str(entry))
+  return sep.join(parts)
+
+
+def match_partition_rules(
+    rules: Sequence[Tuple[str, PartitionSpec]],
+    params: Any,
+    sep: str = "/",
+) -> Any:
+  """Regex partition rules over a named param tree → PartitionSpec tree.
+
+  Each leaf's slash-joined path (``pre_conv0/kernel``) is matched
+  against `rules` in order via ``re.search``; the FIRST matching rule's
+  spec wins. Scalar and size-1 leaves are always replicated before any
+  rule runs (there is nothing to split), so rule sets only need to name
+  real tensors. A leaf no rule matches raises — a model growing a new
+  param must extend its rules, not silently replicate — so rule sets
+  conventionally end with a ``(".*", P())`` catch-all when replication
+  is the intended default. Works on concrete arrays and on
+  ``jax.eval_shape`` structs alike (only ``.shape`` is read).
+  """
+  def match(path, leaf):
+    name = path_key(path, sep)
+    shape = np.shape(leaf)
+    if len(shape) == 0 or int(np.prod(shape, dtype=np.int64)) == 1:
+      return PartitionSpec()
+    for pattern, spec in rules:
+      if re.search(pattern, name) is not None:
+        return spec
+    raise ValueError(f"Partition rule not found for param: {name}")
+
+  return jax.tree_util.tree_map_with_path(match, params)
+
+
+def partition_specs_for_model(model, mesh: Mesh, axis: str = "model") -> Any:
+  """The model's own TP layout as a PartitionSpec tree, mesh-validated.
+
+  Asks `model` for ``partition_rules(axis=...)`` — the regex → spec
+  pairs a model declares about its OWN param names (the pjit/TPUv4
+  scaling recipe: layouts live with the model, the trainer just applies
+  them) — and matches them over the eval_shape param tree. Falls back
+  to all-replicated specs when the mesh lacks `axis`, the axis has size
+  1, or the model declares no rules, so callers apply the result
+  unconditionally and tp=1 lowers bit-identically to an unsharded run.
+  Every sharded dim is checked divisible by the axis size; a rule
+  splitting a 64-wide channel dim 8 ways is fine, 48 ways is a refusal
+  naming the param, not a silent wrong layout.
+  """
+  shapes = _eval_param_shapes(model)
+  axis_size = mesh.shape.get(axis, 1)
+  rules_fn = getattr(model, "partition_rules", None)
+  if axis_size <= 1 or rules_fn is None:
+    return jax.tree_util.tree_map(lambda leaf: PartitionSpec(), shapes)
+  specs = match_partition_rules(rules_fn(axis=axis), shapes)
+
+  def validate(path, leaf, spec):
+    shape = np.shape(leaf)
+    entries = tuple(spec)
+    for dim, entry in enumerate(entries):
+      names = entry if isinstance(entry, tuple) else (entry,)
+      if axis in [n for n in names if n is not None]:
+        if shape[dim] % axis_size != 0:
+          raise ValueError(
+              f"partition rule for {path_key(path)!r} shards dim {dim} "
+              f"(size {shape[dim]}) over {axis!r} of size {axis_size}, "
+              f"which does not divide it; fix the rule or the mesh")
+    return spec
+
+  return jax.tree_util.tree_map_with_path(
+      validate, shapes, specs,
+      is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def compose_data_axis_spec(shape, base_spec: PartitionSpec, axis: str,
+                           axis_size: int) -> PartitionSpec:
+  """ZeRO-1's data-axis shard composed ONTO an existing (TP) spec.
+
+  Shards the largest `axis_size`-divisible dim that `base_spec` leaves
+  unclaimed over `axis`, preserving the base spec's model-axis entries —
+  the TP×ZeRO composition: an opt-state leaf keeps its param's model
+  split and additionally scatters over the data axis. With
+  ``base_spec=P()`` this reduces EXACTLY to
+  ``largest_divisible_dim_spec`` (the pure-DP ZeRO-1 rule, unchanged).
+  """
+  base = list(tuple(base_spec)) + [None] * (len(shape) - len(tuple(base_spec)))
+  divisible = [i for i, s in enumerate(shape)
+               if base[i] is None and s >= axis_size
+               and s % axis_size == 0]
+  if not divisible:
+    if any(entry is not None for entry in base):
+      return PartitionSpec(*base)
+    return PartitionSpec()
+  dim = max(divisible, key=lambda i: shape[i])
+  base[dim] = axis
+  return PartitionSpec(*base)
 
 
 def _eval_param_shapes(model) -> Any:

@@ -180,12 +180,17 @@ class AnakinLoop(TargetNetwork):
           f"so the fused learn body cannot run data-parallel. Use a batch "
           f"of "
           f"{mesh_lib.nearest_multiples(buffer.sample_batch_size, axis_size)}.")
-    self._sharded = axis_size > 1
+    # Mesh placement is gated on the WHOLE mesh, not the data axis: a
+    # dp=1/tp>1 mesh (the rule-partitioned flagship) still needs env
+    # state and targets placed on the mesh — params shard over the
+    # model axis, and un-placed host trees next to sharded params would
+    # mix devices inside the fused jit. The 1-device mesh keeps the
+    # r09 plain-copy path — the unchanged semantics oracle.
+    self._sharded = self.mesh.size > 1
     # Target variables live replicated ON THE MESH when sharded (the
     # AOT executable is lowered against this placement; a host-numpy
     # refresh landing on device 0 only would make every shard read CEM
-    # labels across the mesh). The 1-device mesh keeps the r09 plain
-    # copy — the single-device path is the unchanged semantics oracle.
+    # labels across the mesh).
     super().__init__(
         polyak_tau=polyak_tau,
         sharding=(mesh_lib.replicated_sharding(self.mesh)
@@ -432,11 +437,29 @@ class AnakinLoop(TargetNetwork):
     of arXiv:2204.06514 applied to the WHOLE production loop.
     """
     if self._exec is None:
+      fn = self._build_anakin_fn()
+      if self._sharded:
+        # Donated AOT boundary stability: every dispatch's OUTPUT state
+        # must carry the same layout as its input, or the second
+        # dispatch rejects its own carried state. Warm-up dispatches
+        # route params through the skip branch of the min-fill cond
+        # (no in-body constraint lands), so XLA propagation is free to
+        # pick a different output layout for TP-catch-all leaves —
+        # pin the whole TrainState to the caller's concrete shardings.
+        state_shardings = jax.tree_util.tree_map(
+            lambda leaf: leaf.sharding, train_state)
+        inner_fn = fn
+
+        def fn(ts, env_state, buffer_state, target_variables, outer):
+          ts, env_state, buffer_state, metrics = inner_fn(
+              ts, env_state, buffer_state, target_variables, outer)
+          ts = jax.lax.with_sharding_constraint(ts, state_shardings)
+          return ts, env_state, buffer_state, metrics
+
       args = (train_state, self._env_state, self._buffer.state,
               self._target_variables, jnp.zeros((), jnp.int32))
       self._exec = jax.jit(
-          self._build_anakin_fn(),
-          donate_argnums=(0, 1, 2)).lower(*args).compile()
+          fn, donate_argnums=(0, 1, 2)).lower(*args).compile()
       self.compile_counts["anakin_step"] = (
           self.compile_counts.get("anakin_step", 0) + 1)
       if self._ledger is not None:

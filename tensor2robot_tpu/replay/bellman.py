@@ -74,7 +74,10 @@ def make_cem_states_and_score(model, fns, variables, images,
     # Encode once at the scoring dtype: the code then rides the tiled
     # score's "image" key already in bf16 (its floating-input cast is a
     # no-op), identical Q function and search to the tiled bf16 form.
-    lp_variables = cem.cast_scoring_variables(variables, precision)
+    # scoring_weights_view keeps the encode DENSE under every tier —
+    # int8's view is the quantize→dequantize round trip, so the hoisted
+    # tower sees exactly the weights the serving executables score with.
+    lp_variables = cem.scoring_weights_view(variables, precision)
     states = encode_fn(
         lp_variables,
         {"image": images.astype(cem.scoring_dtype(precision))})

@@ -848,11 +848,26 @@ class MegastepLearner(TargetNetwork):
     XLA from re-staging buffers between dispatches.
     """
     if self._exec is None:
+      fn = self._build_megastep_fn()
+      if self._trainer.mesh.size > 1:
+        # Same donated-AOT boundary rule as AnakinLoop.compiled: on a
+        # multi-device mesh the output TrainState layout is pinned to
+        # the caller's concrete shardings so every dispatch re-enters
+        # at the layout it was lowered against.
+        state_shardings = jax.tree_util.tree_map(
+            lambda leaf: leaf.sharding, train_state)
+        inner_fn = fn
+
+        def fn(ts, buffer_state, target_variables, outer, seed0):
+          ts, buffer_state, metrics = inner_fn(
+              ts, buffer_state, target_variables, outer, seed0)
+          ts = jax.lax.with_sharding_constraint(ts, state_shardings)
+          return ts, buffer_state, metrics
+
       args = (train_state, self._buffer.state, self._target_variables,
               jnp.zeros((), jnp.int32), jnp.zeros((), jnp.uint32))
       self._exec = jax.jit(
-          self._build_megastep_fn(),
-          donate_argnums=(0, 1)).lower(*args).compile()
+          fn, donate_argnums=(0, 1)).lower(*args).compile()
       self.compile_counts["megastep"] = (
           self.compile_counts.get("megastep", 0) + 1)
       if self._ledger is not None:

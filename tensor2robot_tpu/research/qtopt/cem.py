@@ -10,10 +10,10 @@ jit once, no per-iteration host round-trips; batched over control states
 via vmap. Scoring uses ONE batched Q call per iteration (the reference
 did the same through batched session.run).
 
-Precision tiers (ISSUE 13): Q scoring inside CEM dominates acting,
+Precision tiers (ISSUE 13/16): Q scoring inside CEM dominates acting,
 Bellman labeling, AND serving, and ran f32 end-to-end through r13. The
-``precision`` policy ("f32" | "bf16") threads one value through the
-whole scoring stack — this module's score-fn builders, the Bellman
+``precision`` policy ("f32" | "bf16" | "int8") threads one value
+through the whole scoring stack — this module's score-fn builders, the Bellman
 target recipe (replay/bellman.py), the serving bucket executables
 (serving/policy.py), and the fused loops (replay/anakin.py,
 replay/device_buffer.py). The mixed-precision convention follows the
@@ -38,10 +38,26 @@ import jax.numpy as jnp
 
 # The supported scoring tiers. f32 is the oracle (bit-identical to the
 # pre-tier lowering); bf16 is the inference tier proved safe by parity
-# bars (PRECISION_r14.json) and the shadow/canary rollout harness.
-SCORING_PRECISIONS = ("f32", "bf16")
+# bars (PRECISION_r14.json) and the shadow/canary rollout harness;
+# int8 (ISSUE 16, the tier the PR 10 notes pre-wired) quantizes the
+# SERVED params — per-channel symmetric weight-only int8, the
+# HBM-bandwidth half of the Gemma-style serving win — while
+# activations and the CEM search keep the existing tier contract
+# (bf16 matmuls, scores back to f32 before top_k). Like bf16, int8
+# enters a fleet only through the shadow→canary→promote gate.
+SCORING_PRECISIONS = ("f32", "bf16", "int8")
 
-_SCORING_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+# The dtype scoring ACTIVATIONS run in per tier. int8 is weight-only
+# (w8a16): params live in HBM as int8 + per-channel scales and are
+# dequantized to bf16 inside the compiled program, so its activation
+# dtype is bf16 — the search contract is the bf16 tier's.
+_SCORING_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16,
+                   "int8": jnp.bfloat16}
+
+# Wrapper-dict sentinel keys marking one quantized weight leaf:
+# {_QUANT_KEY: int8 array, _SCALE_KEY: f32 per-output-channel scales}.
+_QUANT_KEY = "int8_q"
+_SCALE_KEY = "int8_scale"
 
 
 def validate_precision(precision: str) -> str:
@@ -70,15 +86,104 @@ def cast_scoring_variables(variables, precision: str):
   tables — pass through); inside a jitted score program the cast is
   part of the executable, so a served tree is quantized once per
   dispatch, never mutated in place — the f32 master params are what
-  gradients and promotions continue to see.
+  gradients and promotions continue to see. int8 returns the
+  quantized-wrapper tree (quantize_scoring_variables) — matmul weights
+  become {int8, per-channel scale} pairs, everything else passes
+  through — and is IDEMPOTENT on an already-quantized tree, so a
+  serving policy can pre-quantize at placement time (the HBM win) and
+  still route the tree through this one cast boundary.
   """
   if validate_precision(precision) == "f32":
     return variables
+  if precision == "int8":
+    return quantize_scoring_variables(variables)
   dtype = _SCORING_DTYPES[precision]
   return jax.tree_util.tree_map(
       lambda leaf: leaf.astype(dtype)
       if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating) else leaf,
       variables)
+
+
+# -- int8 weight quantization (ISSUE 16) -------------------------------------
+
+
+def _is_quant_wrapper(node) -> bool:
+  return (isinstance(node, dict)
+          and set(node.keys()) == {_QUANT_KEY, _SCALE_KEY})
+
+
+def quantize_scoring_variables(variables):
+  """Per-channel symmetric int8 quantization of the WEIGHT leaves.
+
+  Every floating leaf with ndim >= 2 (conv/dense kernels — where the
+  bytes are) becomes ``{int8_q, int8_scale}``: symmetric per-OUTPUT-
+  channel scales (absmax over all dims but the last, floored at 1e-8
+  so an all-zero channel quantizes to zeros instead of NaN), values
+  rounded into [-127, 127]. Biases, norm vectors, and integer leaves
+  pass through untouched — they are a rounding-error fraction of the
+  bytes and keeping them exact keeps the tier's q-agreement tight.
+  Idempotent: an already-wrapped leaf passes through, so the cast
+  boundary can run inside a compiled program over a pre-quantized
+  serving tree without double-quantizing.
+  """
+  def quant(node):
+    if _is_quant_wrapper(node):
+      return node
+    arr = jnp.asarray(node)
+    if not jnp.issubdtype(arr.dtype, jnp.floating) or arr.ndim < 2:
+      return node
+    w = arr.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=tuple(range(arr.ndim - 1)),
+                     keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {_QUANT_KEY: q, _SCALE_KEY: scale}
+
+  return jax.tree_util.tree_map(quant, variables,
+                                is_leaf=_is_quant_wrapper)
+
+
+def dequantize_scoring_variables(variables, dtype=jnp.bfloat16):
+  """Dense `dtype` view of a (possibly) quantized tree: wrapped leaves
+  expand ``int8 * scale`` (f32 multiply, then one cast — the scale
+  stays exact), unwrapped floating leaves cast to `dtype`, integer
+  leaves pass through. Inside a jitted score program this is the
+  per-dispatch w8→bf16 expansion; the int8 residency in HBM is what
+  the executable's params ARGUMENT keeps."""
+  def dequant(node):
+    if _is_quant_wrapper(node):
+      return (node[_QUANT_KEY].astype(jnp.float32)
+              * node[_SCALE_KEY]).astype(dtype)
+    arr = jnp.asarray(node)
+    if jnp.issubdtype(arr.dtype, jnp.floating):
+      return arr.astype(dtype)
+    return node
+
+  return jax.tree_util.tree_map(dequant, variables,
+                                is_leaf=_is_quant_wrapper)
+
+
+def is_quantized_variables(variables) -> bool:
+  """True when the tree holds at least one quantized-wrapper leaf."""
+  leaves = jax.tree_util.tree_leaves(variables, is_leaf=_is_quant_wrapper)
+  return any(_is_quant_wrapper(leaf) for leaf in leaves)
+
+
+def scoring_weights_view(variables, precision: str):
+  """A DENSE params tree a model fn can consume at `precision`.
+
+  The factored-CEM consumers (replay/bellman.py's encode-once path)
+  call model fns with a plain params tree; under int8 the tier's view
+  is the quantize→dequantize ROUND TRIP — the same values the serving
+  executables score with (weights snapped to the int8 grid, expanded
+  to bf16) — so labeling and serving agree about what the tier
+  computes. f32 is identity; bf16 is the plain cast."""
+  if validate_precision(precision) == "f32":
+    return variables
+  if precision == "int8":
+    return dequantize_scoring_variables(
+        quantize_scoring_variables(variables), _SCORING_DTYPES[precision])
+  return cast_scoring_variables(variables, precision)
 
 
 def cem_optimize(
@@ -183,6 +288,13 @@ def make_tiled_q_score_fn(fn, variables, precision: str = "f32"):
   candidate actions to bfloat16 — so promotion-driven modules run their
   matmuls in bf16 — and the scores back to float32 before they reach
   elite selection (f32 accumulation, the pjit/TPUv4 convention).
+
+  precision="int8" is the bf16 body over w8-quantized params: the cast
+  boundary quantizes the weights (idempotent on a pre-quantized
+  serving tree — what a policy keeps resident in HBM), the score body
+  expands them int8→bf16 per dispatch, and images/actions/score
+  returns follow the bf16 contract exactly — activation numerics are
+  the proven tier's, only the weights ride the int8 grid.
   """
   if validate_precision(precision) == "f32":
     def score(image, actions):
@@ -198,11 +310,13 @@ def make_tiled_q_score_fn(fn, variables, precision: str = "f32"):
   lp_variables = cast_scoring_variables(variables, precision)
 
   def score_lp(image, actions):
+    weights = (dequantize_scoring_variables(lp_variables, dtype)
+               if precision == "int8" else lp_variables)
     image = image.astype(dtype)
     tiled = jnp.broadcast_to(image[None],
                              (actions.shape[0],) + image.shape)
-    outputs = fn(lp_variables, {"image": tiled,
-                                "action": actions.astype(dtype)})
+    outputs = fn(weights, {"image": tiled,
+                           "action": actions.astype(dtype)})
     return jnp.reshape(outputs["q_predicted"], (-1,)).astype(jnp.float32)
 
   return score_lp
@@ -231,7 +345,7 @@ def fleet_cem_optimize(
     states: (B, ...) batch of states (pytree leaves batched on axis 0).
     keys: (B,) PRNG keys, one per state.
     precision: the scoring tier the caller built `score_fn` at
-      ("f32" | "bf16"). Validated here so one `precision` value threads
+      (SCORING_PRECISIONS). Validated here so one `precision` value threads
       the whole stack and a typo fails at the optimizer call; the tier
       itself lives in score_fn (`make_tiled_q_score_fn(precision=)`) —
       the SEARCH arithmetic (Gaussian sampling, elite refit, clipping,

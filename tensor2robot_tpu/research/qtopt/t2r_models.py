@@ -232,3 +232,29 @@ class QTOptGraspingModel(CriticModel):
         norm_kind=self._norm,
         stem_kind=self._stem,
         impl=self._impl)
+
+  def partition_rules(self, axis: str = "model"):
+    """Regex partition rules → PartitionSpecs for tensor parallelism.
+
+    The tower is column-parallel on its 64-wide channel dim: every conv
+    kernel (HWIO, both stems, the parity and fast post-conv forms share
+    names by construction) and dense kernel splits its OUTPUT features
+    over `axis`, and the per-channel vectors riding those outputs
+    (biases, norm scale/bias) split the same way, so each shard owns a
+    contiguous channel slice end to end — the only cross-shard
+    collectives are where channels actually mix (the next layer's
+    input contraction). The f32 ``q_head`` (64→1) stays replicated:
+    splitting a width-1 output buys nothing. Matched first-hit-wins by
+    ``parallel.tp_rules.match_partition_rules``; the catch-all keeps
+    future scalars/aux leaves replicated rather than unmatched.
+    """
+    from jax.sharding import PartitionSpec as P
+    return (
+        (r"(stem|pre_conv\d|post_conv\d)/kernel", P(None, None, None, axis)),
+        (r"stem_s2d_kernel", P(None, None, None, axis)),
+        (r"(action_fc\d|fc1)/kernel", P(None, axis)),
+        (r"(stem|pre_conv\d|post_conv\d|action_fc\d|fc1)/bias", P(axis)),
+        (r"stem_s2d_bias", P(axis)),
+        (r"(stem_bn|pre_bn\d|post_bn\d)/(scale|bias)", P(axis)),
+        (r".*", P()),
+    )

@@ -47,13 +47,20 @@ class CEMFleetPolicy:
                ladder: Optional[BucketLadder] = None,
                device=None,
                ledger: Optional[ledger_lib.ExecutableLedger] = None,
-               precision: str = "f32"):
+               precision: str = "f32",
+               param_specs=None):
     """See class docstring. `device` pins this policy's executables and
     inputs to ONE jax.Device — the fleet router's replica placement
     (serving/router.py): each mesh device gets its own policy whose
     ladder compiles exactly once per bucket PER DEVICE, and request
-    batches are device_put onto that replica before dispatch. None
-    keeps the default placement (single-chip behavior, unchanged).
+    batches are device_put onto that replica before dispatch — OR one
+    jax.sharding.Mesh (ISSUE 16): a tensor-parallel replica GROUP.
+    With a Mesh, request batches replicate over the group and the
+    served params shard per `param_specs` (the model's partition
+    rules), so one critic too wide for a single device serves from a
+    group of them; ledger keys carry the group's ``mesh{...}`` label.
+    None keeps the default placement (single-chip behavior,
+    unchanged).
     `ledger` (optional): an obs.ledger.ExecutableLedger that each
     bucket registers into (cost_analysis joined) and whose dispatch
     wall time the call path records — entries are keyed
@@ -62,13 +69,23 @@ class CEMFleetPolicy:
     `precision` (ISSUE 13) is the Q-scoring tier of every bucket
     executable this policy compiles (cem.SCORING_PRECISIONS). One
     policy serves ONE tier — a fleet running two tiers (the rollout
-    harness's bf16 candidate next to f32 live) builds one policy per
-    tier, and the non-f32 ledger keys carry a ``_<tier>`` suffix
-    (``cem_bucket_4_bf16@<device>``) so the fleet ledger proves
+    harness's bf16 or int8 candidate next to f32 live) builds one
+    policy per tier, and the non-f32 ledger keys carry a ``_<tier>``
+    suffix (``cem_bucket_4_int8@<device>``) so the fleet ledger proves
     exactly-once compilation PER TIER, not just per bucket. The f32
-    default leaves keys and lowering exactly as r10 (the oracle)."""
+    default leaves keys and lowering exactly as r10 (the oracle).
+    The int8 tier quantizes the served tree at PLACEMENT time
+    (`_place`): what each replica keeps resident in HBM is the int8
+    weights + per-channel scales — the param-bytes-per-replica
+    reduction the TPQUANT artifact measures — and the compiled score
+    body only dequantizes per dispatch.
+    `param_specs`: optional PartitionSpec pytree over the predictor
+    variables' ``params`` subtree, applied only when `device` is a
+    Mesh and the served tree is dense (the int8-quantized wrapper tree
+    replicates — its bytes are already small)."""
     self._predictor = predictor
     self.precision = cem.validate_precision(precision)
+    self.param_specs = param_specs
     self._action_size = action_size
     self._num_samples = num_samples
     self._num_elites = num_elites
@@ -175,9 +192,20 @@ class CEMFleetPolicy:
       return actions, scores
     return actions
 
+  @property
+  def device_label(self) -> Optional[str]:
+    """The ledger/registry label for this policy's placement: the
+    device's own name, or ``mesh{axis: size}`` for a tensor-parallel
+    replica group (a Mesh's repr is too verbose for a row key)."""
+    if self.device is None:
+      return None
+    if isinstance(self.device, jax.sharding.Mesh):
+      return f"mesh{dict(self.device.shape)}"
+    return str(self.device)
+
   def _ledger_key(self, bucket: int) -> str:
     tier = f"_{self.precision}" if self.precision != "f32" else ""
-    suffix = f"@{self.device}" if self.device is not None else ""
+    suffix = (f"@{self.device_label}" if self.device is not None else "")
     return f"cem_bucket_{bucket}{tier}{suffix}"
 
   # -- device placement ----------------------------------------------------
@@ -185,17 +213,29 @@ class CEMFleetPolicy:
   def _put(self, array):
     if self.device is None:
       return jnp.asarray(array)
+    if isinstance(self.device, jax.sharding.Mesh):
+      from tensor2robot_tpu.parallel import mesh as mesh_lib
+      # Request batches replicate over the replica group: every group
+      # member scores the full bucket, with the model-axis split living
+      # in the params (XLA partitions the matmuls, not the batch).
+      return jax.device_put(array, mesh_lib.replicated_sharding(self.device))
     return jax.device_put(array, self.device)
 
   def _place(self, variables):
-    """Device-placed view of a variables pytree, cached per identity.
+    """Device-placed (and, for int8, quantized) view of a variables
+    pytree, cached per identity.
 
     Without a pinned device this is a no-op (jit moves host trees under
     the default placement exactly as before). With one, the tree is
     device_put ONCE per distinct params object: the live params after
     each hot reload, plus at most a rollout candidate — so a replica
     never re-uploads weights per request, and a param refresh costs one
-    transfer, zero compiles.
+    transfer, zero compiles. The int8 tier quantizes HERE, before the
+    transfer, so what a replica keeps resident is the int8 tree (the
+    HBM reduction is per replica, not just per dispatch) — the cast
+    boundary inside the executable is idempotent on it. A Mesh device
+    places dense trees per `param_specs` (params subtree sharded over
+    the group's model axis, everything else replicated).
     """
     if self.device is None:
       return variables
@@ -206,9 +246,27 @@ class CEMFleetPolicy:
         return entry[1]
       if len(self._placed) >= 4:  # live + candidate + their priors
         self._placed.clear()
-      placed = jax.device_put(variables, self.device)
+      to_place = (cem.cast_scoring_variables(variables, "int8")
+                  if self.precision == "int8" else variables)
+      placed = self._put_variables(to_place)
       self._placed[key] = (variables, placed)
       return placed
+
+  def _put_variables(self, variables):
+    if not isinstance(self.device, jax.sharding.Mesh):
+      return jax.device_put(variables, self.device)
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+    from tensor2robot_tpu.parallel import tp_rules
+    replicated = mesh_lib.replicated_sharding(self.device)
+    if (self.param_specs is None or cem.is_quantized_variables(variables)
+        or not isinstance(variables, dict) or "params" not in variables):
+      return jax.device_put(variables, replicated)
+    placed = {key: jax.device_put(value, replicated)
+              for key, value in variables.items() if key != "params"}
+    placed["params"] = jax.device_put(
+        variables["params"],
+        tp_rules.specs_to_shardings(self.param_specs, self.device))
+    return placed
 
   # -- compiled path -------------------------------------------------------
 
@@ -255,7 +313,7 @@ class CEMFleetPolicy:
         if self._ledger is not None:
           self._ledger.register(
               self._ledger_key(bucket), compiled=compiled,
-              device=self.device, dtype=self.precision,
+              device=self.device_label, dtype=self.precision,
               shapes={"bucket": bucket,
                       "num_samples": self._num_samples,
                       "iterations": self._iterations})
@@ -280,12 +338,16 @@ class CEMFleetPolicy:
     count already fit a bucket exactly.
     """
     if self.precision != "f32":
+      # Satellite fix (ISSUE 16): name the requested tier AND the
+      # supported set, mirroring cem.validate_precision — "which tiers
+      # exist" must not require a second error round-trip.
       raise ValueError(
-          f"precision {self.precision!r} requires the predictor's "
-          "device path (device_fn): the host fallback scores through "
-          "predictor.predict, whose compute dtype cannot be retiered "
-          "per policy. Serve the f32 tier, or use a device-resident "
-          "predictor.")
+          f"scoring precision {self.precision!r} requires the "
+          "predictor's device path (device_fn): the host fallback "
+          "scores through predictor.predict, whose compute dtype "
+          "cannot be retiered per policy. Of the supported tiers "
+          f"{cem.SCORING_PRECISIONS} only 'f32' can serve host-side — "
+          "serve the f32 tier, or use a device-resident predictor.")
     num = self._num_samples
     n = batch.shape[0]
     bucket = self.ladder.bucket_for(n)

@@ -187,6 +187,17 @@ class FleetRouter:
     fault_plan: deterministic fault injection (obs/faults.py) threaded
       to every replica's dispatch seam and batcher. None (the
       default) is the oracle path: no plan, no new work on dispatch.
+    tp_group (ISSUE 16): devices per tensor-parallel replica GROUP.
+      1 (default) keeps one replica per device — the unchanged fleet.
+      >1 chunks `devices` into consecutive groups of that size, builds
+      ONE Mesh per group over a ``model`` axis, and pins one policy
+      per GROUP: the served critic's params shard over the group per
+      `param_specs` (the model's partition rules), request batches
+      replicate within it — a critic too wide for one device serves
+      from a group of them. len(devices) must divide evenly.
+    param_specs: PartitionSpec pytree for the predictor's params
+      subtree, forwarded to every replica policy (meaningful with
+      tp_group > 1).
     cem / ladder kwargs: forwarded to each replica's CEMFleetPolicy.
   """
 
@@ -203,7 +214,9 @@ class FleetRouter:
                flight_recorder=None,
                precision: str = "f32",
                health: Optional[HealthConfig] = None,
-               fault_plan: Optional[faults_lib.FaultPlan] = None):
+               fault_plan: Optional[faults_lib.FaultPlan] = None,
+               tp_group: int = 1,
+               param_specs=None):
     import jax
 
     from tensor2robot_tpu.research.qtopt import cem
@@ -211,6 +224,24 @@ class FleetRouter:
     devices = list(jax.devices() if devices is None else devices)
     if not devices:
       raise ValueError("FleetRouter needs at least one device.")
+    self.tp_group = int(tp_group)
+    self._param_specs = param_specs
+    if self.tp_group > 1:
+      # Tensor-parallel replica groups: consecutive device chunks, one
+      # Mesh (→ one PolicyReplica) per chunk. Meshes are hashable and
+      # identity-stable here (built once, reused for the fleet's
+      # lifetime), so the policy cache and the replica identity check
+      # keep working unchanged.
+      import numpy as _np
+      if len(devices) % self.tp_group:
+        raise ValueError(
+            f"{len(devices)} device(s) do not split into tensor-"
+            f"parallel groups of {self.tp_group}; pass a device list "
+            f"whose length {len(devices)} is a multiple of tp_group")
+      devices = [
+          jax.sharding.Mesh(
+              _np.asarray(devices[i:i + self.tp_group]), ("model",))
+          for i in range(0, len(devices), self.tp_group)]
     self.stats = stats or ServingStats()
     self._metric_writer = metric_writer
     self._metric_step = 0
@@ -299,6 +330,7 @@ class FleetRouter:
         policy = CEMFleetPolicy(
             self._predictor, ladder=ladder, device=device,
             ledger=self.ledger, precision=precision,
+            param_specs=self._param_specs,
             **self._policy_kwargs)
         self._policy_cache[key] = policy
       return policy

@@ -348,6 +348,42 @@ def prune_sidecars(root: str, keep_steps) -> None:
       shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
 
 
+def mesh_geometry(mesh) -> dict:
+  """JSON-able geometry stamp of a mesh: ordered {axis: size} plus the
+  device count. Saved into checkpoint sidecars so a resume can refuse
+  a geometry change up front (validate_restore_mesh) instead of
+  failing deep inside a device_put against missing axes — shardings
+  themselves are not serialized (orbax restores into the TEMPLATE
+  state's shardings; the stamp is the cheap cross-check that the
+  template's mesh matches the writer's)."""
+  return {"axes": {str(name): int(size)
+                   for name, size in mesh.shape.items()},
+          "devices": int(mesh.size)}
+
+
+def validate_restore_mesh(saved: Optional[dict], mesh) -> None:
+  """Refuses a resume whose mesh geometry differs from the writer's.
+
+  `saved` is the sidecar's mesh_geometry() stamp (None — a pre-stamp
+  checkpoint — passes: older checkpoints stay restorable). A mismatch
+  raises with BOTH geometries and the nearest fix named, matching the
+  ring-buffer refusal convention: say what was found, what was
+  expected, and the exact knob that reconciles them."""
+  if saved is None:
+    return
+  current = mesh_geometry(mesh)
+  if saved == current:
+    return
+  saved_axes = dict(saved.get("axes", {}))
+  fix = " x ".join(f"{name}={size}" for name, size in saved_axes.items())
+  raise ValueError(
+      f"resume mesh geometry mismatch: checkpoint was written on a mesh "
+      f"of {saved}, this loop runs {current} — sharded state cannot be "
+      f"re-laid-out across geometries on restore. Rebuild the loop with "
+      f"a {fix or 'matching'} mesh (the writer's geometry), or start a "
+      f"fresh run for the new mesh.")
+
+
 def restore_params(checkpoint_path: str) -> Any:
   """Loads just the `params` subtree from a run directory or step dir.
 

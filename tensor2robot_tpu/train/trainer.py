@@ -57,20 +57,17 @@ class Trainer:
         leaves are partitioned over the data axis (largest divisible
         dim), cutting per-chip Adam m/v memory by the DP degree while
         params stay replicated — XLA turns the gradient all-reduce +
-        sharded update into reduce-scatter + all-gather. Mutually
-        exclusive with param_specs (TP shards opt state via its own
-        constraints already).
+        sharded update into reduce-scatter + all-gather. COMPOSES with
+        param_specs (the pjit/TPUv4-paper layering): each opt-state
+        leaf first inherits its parameter's model-axis spec (matched by
+        param-path suffix), then additionally scatters over the data
+        axis on its largest divisible UNCLAIMED dim — with no
+        param_specs this reduces exactly to the pure-DP ZeRO-1 rule.
     """
     self.model = model
     self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
     self.data_axis = data_axis
     self.param_specs = param_specs
-    if shard_optimizer_state and param_specs is not None:
-      raise ValueError(
-          "shard_optimizer_state composes with pure DP only: under "
-          "param_specs the optimizer state already follows the parameter "
-          "shardings (TP shards it over the model axis; FSDP over the "
-          "data axis).")
     self._shard_opt = shard_optimizer_state
     # Pure DP = every TrainState leaf replicated, so the jits can pin
     # explicit in/out shardings; any other mode (TP, sharded opt state)
@@ -101,22 +98,54 @@ class Trainer:
         params, tp_rules.specs_to_shardings(self.param_specs, self.mesh))
 
   def _constrain_opt_state(self, opt_state):
-    """Pins optimizer-state leaves to data-axis shardings (ZeRO-1):
-    each leaf shards its largest data-axis-divisible dim (the same rule
-    FSDP applies to params); scalars and indivisible leaves stay
-    replicated."""
-    if not self._shard_opt:
-      return opt_state
-    from jax.sharding import NamedSharding
-    axis_size = self.mesh.shape[self.data_axis]
+    """Pins optimizer-state leaves to their ZeRO-1 shardings.
 
-    def constrain(leaf):
-      spec = tp_rules.largest_divisible_dim_spec(
-          getattr(leaf, "shape", ()), self.data_axis, axis_size)
+    Pure DP: each leaf shards its largest data-axis-divisible dim (the
+    same rule FSDP applies to params); scalars and indivisible leaves
+    stay replicated — byte-identical to the pre-TP behavior. Under
+    param_specs the two layouts COMPOSE: an opt-state leaf whose path
+    suffix names a parameter (optax states mirror the param tree —
+    ``0/0/mu/pre_conv0/kernel`` ends with ``pre_conv0/kernel``) first
+    inherits that parameter's model-axis spec, then the data axis lands
+    on its largest divisible dim the spec leaves unclaimed
+    (tp_rules.compose_data_axis_spec), so Adam m/v shard over BOTH
+    axes and no constraint fights the parameter layout.
+
+    TP without ZeRO-1 still pins: each opt-state leaf mirrors its
+    parameter's model-axis spec exactly (no data scatter). Leaving
+    these leaves to XLA propagation gives the AOT fused consumers an
+    UNSTABLE boundary — the init executable and the step executable
+    can pick different layouts for the same leaf, and a donated
+    carry-back then rejects its own state on the second dispatch."""
+    if not self._shard_opt and self.param_specs is None:
+      return opt_state
+    from jax.sharding import NamedSharding, PartitionSpec
+    axis_size = self.mesh.shape[self.data_axis]
+    base_specs = {}
+    if self.param_specs is not None:
+      flat, _ = jax.tree_util.tree_flatten_with_path(
+          self.param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+      base_specs = {tp_rules.path_key(path): spec for path, spec in flat}
+
+    def base_for(key: str) -> PartitionSpec:
+      best, best_len = PartitionSpec(), -1
+      for param_path, spec in base_specs.items():
+        if ((key == param_path or key.endswith("/" + param_path))
+            and len(param_path) > best_len):
+          best, best_len = spec, len(param_path)
+      return best
+
+    def constrain(path, leaf):
+      base = base_for(tp_rules.path_key(path))
+      if self._shard_opt:
+        spec = tp_rules.compose_data_axis_spec(
+            getattr(leaf, "shape", ()), base, self.data_axis, axis_size)
+      else:
+        spec = base  # TP-only: mirror the parameter layout exactly
       return jax.lax.with_sharding_constraint(
           leaf, NamedSharding(self.mesh, spec))
 
-    return jax.tree_util.tree_map(constrain, opt_state)
+    return jax.tree_util.tree_map_with_path(constrain, opt_state)
 
   # --- state ---------------------------------------------------------------
 
@@ -126,7 +155,8 @@ class Trainer:
       variables = self.model.init_variables(rng, batch_size=batch_size)
       variables = dict(variables)
       params = self._constrain_params(variables.pop("params"))
-      ema = (jax.tree_util.tree_map(jnp.copy, params)
+      ema = (self._constrain_params(
+          jax.tree_util.tree_map(jnp.copy, params))
              if self.model.use_avg_model_params else None)
       return TrainState(
           step=jnp.zeros((), jnp.int32),
@@ -183,6 +213,9 @@ class Trainer:
       new_ema = optax.incremental_update(
           new_params, new_ema,
           step_size=1.0 - self.model.avg_model_params_decay)
+      # EMA mirrors the param layout; pinning it keeps the donated AOT
+      # boundary stable under TP (same rationale as _constrain_opt_state).
+      new_ema = self._constrain_params(new_ema)
     return state.replace(
         step=state.step + 1,
         params=new_params,

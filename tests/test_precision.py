@@ -142,7 +142,7 @@ class TestPrecisionPolicy:
     states = np.zeros((2, 16, 16, 3), np.uint8)
     keys = jax.random.split(jax.random.key(0), 2)
     with pytest.raises(ValueError, match="precision"):
-      cem.fleet_cem_optimize(score, states, keys, 4, precision="int8")
+      cem.fleet_cem_optimize(score, states, keys, 4, precision="fp16")
 
   def test_bellman_targets_bf16_stay_f32_and_clipped(
       self, tiny_model_and_variables):
@@ -466,6 +466,97 @@ class TestRolloutPrecisionCandidate:
     assert all(count == 1 for count in counts.values()), counts
     assert any(key.startswith("cem_bucket_1_bf16") for key in counts), (
         counts)
+
+
+class TestThreeTierLedger:
+  """Satellite (ISSUE 16): THREE concurrent tiers — f32, bf16, int8 —
+  through hot reload and a promote cycle, exactly-once per
+  (bucket, device, dtype)."""
+
+  def test_three_tiers_survive_hot_reload(self,
+                                          tiny_model_and_variables):
+    import jax
+
+    from tensor2robot_tpu.obs import ledger as ledger_lib
+    from tensor2robot_tpu.replay.loop import _HotReloadPredictor
+    from tensor2robot_tpu.serving.bucketing import BucketLadder
+    from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+    model, variables = tiny_model_and_variables
+    predictor = _HotReloadPredictor(model, variables)
+    ledger = ledger_lib.ExecutableLedger()
+    frames = [np.zeros((16, 16, 3), np.uint8)] * 2
+    policies = {
+        precision: CEMFleetPolicy(
+            predictor, action_size=4, num_samples=8, num_elites=2,
+            iterations=1, seed=0, ladder=BucketLadder((2,)),
+            ledger=ledger, precision=precision)
+        for precision in ("f32", "bf16", "int8")}
+    for policy in policies.values():
+      policy(frames, np.arange(2, dtype=np.uint32))
+    # Hot reload: new variables through every tier, zero recompiles —
+    # int8 re-quantizes at placement time, same executable.
+    bumped = jax.tree_util.tree_map(lambda x: x + 0.05, variables)
+    predictor.update(bumped)
+    actions = {
+        precision: np.asarray(policy(frames,
+                                     np.arange(2, dtype=np.uint32)))
+        for precision, policy in policies.items()}
+    counts = ledger.compile_counts
+    assert counts == {"cem_bucket_2": 1, "cem_bucket_2_bf16": 1,
+                      "cem_bucket_2_int8": 1}, counts
+    tiers = ledger.attribution(wall_seconds=10.0)["tier_shares"]
+    assert set(tiers) == {"f32", "bf16", "int8"}
+    for precision, action in actions.items():
+      assert np.all(np.isfinite(action)), precision
+
+  @pytest.mark.slow
+  def test_three_tiers_through_promote_cycles(self):
+    import time
+
+    from tensor2robot_tpu.serving.rollout import (RolloutConfig,
+                                                  RolloutController)
+    from tensor2robot_tpu.serving.router import FleetRouter
+    from tensor2robot_tpu.serving.smoke import TinyQPredictor
+    predictor = TinyQPredictor(seed=0)
+    router = FleetRouter(predictor, ladder_sizes=(1, 2), num_samples=8,
+                         num_elites=2, iterations=1, max_queue=16,
+                         seed=0)
+    router.warmup(predictor.make_image)
+    controller = RolloutController(
+        router, predictor,
+        RolloutConfig(mirror_fraction=1.0, canary_fraction=0.5,
+                      min_shadow_samples=4, min_canary_samples=2,
+                      seed=0))
+    frames = [predictor.make_image(i) for i in range(8)]
+
+    def drive(i0):
+      stop_at = time.monotonic() + 60.0
+      i = i0
+      while controller.state != "serving" and time.monotonic() < stop_at:
+        controller.submit(frames[i % len(frames)]).result(30.0)
+        i += 1
+      return i
+
+    with router, controller:
+      # bf16 promotes first, then int8 on the bf16-serving fleet: the
+      # three tiers' executables coexist on every replica.
+      assert controller.offer_precision_candidate("bf16")
+      i = drive(0)
+      assert router.precision == "bf16"
+      assert controller.offer_precision_candidate("int8")
+      drive(i)
+      assert router.precision == "int8"
+      action = np.asarray(controller.act(frames[0], timeout=30.0))
+      assert action.shape == (4,)
+    counts = router.ledger.compile_counts
+    assert counts, counts
+    # Exactly once per (bucket, device, dtype) across warmup, both
+    # promote cycles, and post-promote traffic.
+    assert all(count == 1 for count in counts.values()), counts
+    for tier in ("_bf16", "_int8"):
+      assert any(tier in key for key in counts), (tier, counts)
+    tiers = router.ledger.attribution(wall_seconds=10.0)["tier_shares"]
+    assert {"f32", "bf16", "int8"} <= set(tiers)
 
 
 class TestPrecisionBenchAndCLI:

@@ -1,0 +1,658 @@
+"""Flagship critic at mesh scale (ISSUE 16): rule-partitioned TP +
+the int8 served-weights tier.
+
+Tier-1 contracts for the round-17 tentpole: regex partition rules
+resolve named param trees to PartitionSpecs (first match wins,
+unmatched leaves RAISE, scalar/size-1 leaves auto-replicate), the
+flagship `QTOptGraspingModel` declares a complete rule set (conv/fc
+kernels + channel vectors split, `q_head` replicated) that
+mesh-validates divisibility; the Trainer pins params, optimizer state,
+AND the EMA tree to the specs (TP alone and composed with ZeRO-1); the
+fused Anakin loop runs a dp=1/tp=2 mesh through ONE `anakin_step` with
+leaf shardings genuinely carrying the model axis; tp=1 builds
+all-replicated specs (the r09/r10 oracle path); the int8 tier
+quantizes per output channel with a bounded round-trip error,
+idempotently, behind the same f32-scores contract as bf16; tp-sharded
+TrainStates round-trip through the orbax checkpoint layer with their
+layout intact and a geometry-changed resume refuses up front with the
+nearest fix named; HealthMonitor drift baselines ride the checkpoint
+sidecar and re-seat on resume; the host fallback names the requested
+tier AND the supported set; and the committed `TPQUANT_r17.json` meets
+every acceptance bar it was generated under.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+QUANT = (os.cpu_count() or 1) >= 4
+
+IMG = 12
+
+_TINY_RULES = (
+    (r"(img_fc1|img_code|act_fc1|joint_fc1|joint_fc2)/kernel",
+     P(None, "model")),
+    (r"(img_fc1|img_code|act_fc1|joint_fc1|joint_fc2)/bias", P("model")),
+    (r".*", P()),
+)
+
+
+class TPTinyQCriticModel(TinyQCriticModel):
+  """TinyQ with the flagship's rule contract: column-parallel Dense
+  kernels + their bias vectors, replicated q_head — the cheap model
+  the fused-loop TP tests partition (the flagship itself is covered
+  by the committed artifact and the bench lanes)."""
+
+  def partition_rules(self, axis: str = "model"):
+    return tuple(
+        (pattern, P(*[axis if e == "model" else e for e in tuple(spec)]))
+        for pattern, spec in _TINY_RULES)
+
+
+def _mesh(shape):
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  needed = 1
+  for size in shape.values():
+    needed *= size
+  return mesh_lib.create_mesh(shape, devices=jax.devices()[:needed])
+
+
+def _spec_names(sharding):
+  spec = getattr(sharding, "spec", None) or ()
+  names = set()
+  for entry in spec:
+    for name in (entry,) if isinstance(entry, str) else (entry or ()):
+      names.add(name)
+  return names
+
+
+# -- partition rules ---------------------------------------------------------
+
+
+class TestPartitionRules:
+
+  def _params(self):
+    return {
+        "fc1": {"kernel": np.zeros((8, 64), np.float32),
+                "bias": np.zeros((64,), np.float32)},
+        "head": {"kernel": np.zeros((64, 1), np.float32)},
+        "scalar": np.zeros((), np.float32),
+        "one": np.zeros((1,), np.float32),
+    }
+
+  def test_first_match_wins_over_named_paths(self):
+    from tensor2robot_tpu.parallel import tp_rules
+    specs = tp_rules.match_partition_rules(
+        ((r"fc1/kernel", P(None, "model")),
+         (r"kernel", P()),  # would also match fc1/kernel — must lose
+         (r".*", P())),
+        self._params())
+    assert specs["fc1"]["kernel"] == P(None, "model")
+    assert specs["head"]["kernel"] == P()
+    assert specs["fc1"]["bias"] == P()
+
+  def test_unmatched_leaf_raises_naming_the_param(self):
+    from tensor2robot_tpu.parallel import tp_rules
+    with pytest.raises(ValueError,
+                       match=r"Partition rule not found for param: "
+                             r"head/kernel"):
+      tp_rules.match_partition_rules(
+          ((r"fc1/.*", P()),), self._params())
+
+  def test_scalar_and_size_one_leaves_replicate_before_rules(self):
+    from tensor2robot_tpu.parallel import tp_rules
+    # The only rule would SHARD everything — scalars/size-1 leaves
+    # must be replicated before it ever runs (nothing to split), and
+    # must not count as unmatched either.
+    specs = tp_rules.match_partition_rules(
+        ((r".*", P("model")),), self._params())
+    assert specs["scalar"] == P()
+    assert specs["one"] == P()
+    assert specs["fc1"]["bias"] == P("model")
+
+  def test_flagship_rules_cover_every_param(self):
+    from tensor2robot_tpu.parallel import tp_rules
+    from tensor2robot_tpu.research.qtopt.t2r_models import (
+        QTOptGraspingModel)
+    model = QTOptGraspingModel(
+        image_size=16, optimizer_fn=lambda: optax.adam(1e-3),
+        uint8_images=True, norm="group")
+    specs = tp_rules.partition_specs_for_model(
+        model, _mesh({"data": 1, "model": 2}))
+    flat = {tp_rules.path_key(path): spec
+            for path, spec in jax.tree_util.tree_flatten_with_path(
+                specs, is_leaf=lambda x: isinstance(x, P))[0]}
+    # Conv kernels split their output-channel dim; the q head stays
+    # replicated (64 -> 1: splitting a width-1 output buys nothing).
+    conv = [key for key in flat if key.endswith("/kernel")
+            and len(tuple(flat[key])) == 4]
+    assert conv, sorted(flat)
+    for key in conv:
+      assert flat[key] == P(None, None, None, "model"), (key, flat[key])
+    head = [key for key in flat if "q_head" in key]
+    assert head, sorted(flat)
+    for key in head:
+      assert flat[key] == P(), (key, flat[key])
+    # Most leaves are sharded: the tower is genuinely partitioned,
+    # not a replicated tree with one token split.
+    sharded = [key for key in flat if "model" in _names(flat[key])]
+    assert len(sharded) > len(flat) // 2, (len(sharded), len(flat))
+
+  def test_tp1_mesh_yields_all_replicated_specs(self):
+    from tensor2robot_tpu.parallel import tp_rules
+    model = TPTinyQCriticModel(optimizer_fn=lambda: optax.adam(1e-3))
+    specs = tp_rules.partition_specs_for_model(
+        model, _mesh({"data": 1, "model": 1}))
+    leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    assert all(spec == P() for spec in leaves)
+
+  def test_indivisible_rule_refuses_naming_param_and_sizes(self):
+    from tensor2robot_tpu.parallel import tp_rules
+    model = TPTinyQCriticModel(optimizer_fn=lambda: optax.adam(1e-3))
+    # 64- and 32-wide outputs do not divide a 3-way model axis.
+    with pytest.raises(ValueError, match=r"does not divide"):
+      tp_rules.partition_specs_for_model(
+          model, _mesh({"data": 1, "model": 3}))
+
+  def test_compose_data_axis_spec_layers_zero1_onto_tp(self):
+    from tensor2robot_tpu.parallel import tp_rules
+    # TP-claimed kernel: ZeRO-1 scatters the data axis over the
+    # largest UNCLAIMED divisible dim, preserving the model entry.
+    spec = tp_rules.compose_data_axis_spec(
+        (8, 64), P(None, "model"), "data", 2)
+    assert spec == P("data", "model")
+    # No unclaimed divisible dim: the base spec survives untouched.
+    spec = tp_rules.compose_data_axis_spec((3, 64), P(None, "model"),
+                                           "data", 2)
+    assert spec == P(None, "model")
+    # Empty base reduces exactly to the pure-DP ZeRO-1 rule.
+    assert (tp_rules.compose_data_axis_spec((8, 64), P(), "data", 2)
+            == tp_rules.largest_divisible_dim_spec((8, 64), "data", 2))
+
+
+def _names(spec):
+  names = set()
+  for entry in tuple(spec):
+    for name in (entry,) if isinstance(entry, str) else (entry or ()):
+      names.add(name)
+  return names
+
+
+# -- trainer composition -----------------------------------------------------
+
+
+class TestTrainerTPComposition:
+  """param_specs pin params, opt state, and EMA — alone and with
+  ZeRO-1 — so the donated AOT boundary stays stable under TP."""
+
+  def _build(self, shape, zero1, ema=False):
+    from tensor2robot_tpu.parallel import tp_rules
+    from tensor2robot_tpu.train.trainer import Trainer
+    model = TPTinyQCriticModel(image_size=IMG,
+                               use_avg_model_params=ema,
+                               optimizer_fn=lambda: optax.adam(1e-3))
+    mesh = _mesh(shape)
+    specs = tp_rules.partition_specs_for_model(model, mesh)
+    trainer = Trainer(model, mesh=mesh, seed=0, param_specs=specs,
+                      shard_optimizer_state=zero1)
+    return trainer, trainer.create_train_state(batch_size=8)
+
+  def test_tp_only_params_and_opt_state_carry_model_axis(self):
+    trainer, state = self._build({"data": 1, "model": 2}, zero1=False)
+    kernel = state.params["img_fc1"]["kernel"]
+    assert "model" in _spec_names(kernel.sharding)
+    # TP without ZeRO-1: opt-state moments MIRROR the param layout
+    # exactly (pinned at init — leaving them to propagation is what
+    # destabilized the donated AOT boundary).
+    mu = jax.tree_util.tree_leaves(state.opt_state)
+    assert any("model" in _spec_names(leaf.sharding) for leaf in mu
+               if hasattr(leaf, "sharding"))
+    flat_params = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    for path, leaf in flat_params:
+      if leaf.ndim >= 1 and leaf.shape[-1] in (32, 64):
+        continue  # sharded by rule
+      assert "model" not in _spec_names(leaf.sharding), path
+
+  def test_tp_zero1_opt_state_carries_both_axes(self):
+    trainer, state = self._build({"data": 2, "model": 2}, zero1=True)
+    kernel = state.params["img_fc1"]["kernel"]
+    # Params: model axis only (ZeRO-1 shards the OPT state, not them).
+    assert _spec_names(kernel.sharding) == {"model"}
+    axes = set()
+    for leaf in jax.tree_util.tree_leaves(state.opt_state):
+      if hasattr(leaf, "sharding"):
+        axes |= _spec_names(leaf.sharding)
+    assert {"data", "model"} <= axes, axes
+
+  def test_ema_tree_mirrors_param_layout(self):
+    trainer, state = self._build({"data": 1, "model": 2}, zero1=False,
+                                 ema=True)
+    assert state.ema_params is not None
+    for param, ema in zip(jax.tree_util.tree_leaves(state.params),
+                          jax.tree_util.tree_leaves(state.ema_params)):
+      assert param.sharding == ema.sharding
+
+
+# -- the fused loop under TP -------------------------------------------------
+
+
+class TestShardedAnakinTP:
+  """ONE fused `anakin_step` with critic params genuinely split over
+  the model axis on a dp=1/tp=2 mesh — the tentpole, at TinyQ scale
+  (the flagship runs the same wiring in the committed artifact)."""
+
+  def _build(self, tp):
+    from tensor2robot_tpu.export import export_utils
+    from tensor2robot_tpu.parallel import tp_rules
+    from tensor2robot_tpu.replay.anakin import AnakinLoop
+    from tensor2robot_tpu.replay.device_buffer import DeviceReplayBuffer
+    from tensor2robot_tpu.replay.loop import transition_spec
+    from tensor2robot_tpu.research.qtopt import jax_grasping as jg
+    from tensor2robot_tpu.train.trainer import Trainer
+    model = TPTinyQCriticModel(image_size=IMG,
+                               optimizer_fn=lambda: optax.adam(1e-3))
+    mesh = _mesh({"data": 1, "model": tp})
+    specs = (tp_rules.partition_specs_for_model(model, mesh)
+             if tp > 1 else None)
+    trainer = Trainer(model, mesh=mesh, seed=0, param_specs=specs)
+    state = trainer.create_train_state(batch_size=8)
+    variables = export_utils.fetch_variables_to_host(
+        state.variables(use_ema=True))
+    buf = DeviceReplayBuffer(
+        transition_spec(IMG, 4), capacity=64, sample_batch_size=8,
+        seed=0, prioritized=True, ingest_chunk=4, mesh=trainer.mesh)
+    bank = jg.make_scene_bank(64, image_size=IMG, base_seed=0)
+    env = jg.JaxGraspEnv(4, image_size=IMG, max_attempts=3, radius=0.4,
+                         bank=bank)
+    loop = AnakinLoop(model, trainer, buf, env, action_size=4,
+                      gamma=0.8, num_samples=4, num_elites=2,
+                      iterations=2, inner_steps=8, train_every=2,
+                      min_fill=0, seed=13)
+    loop.refresh(variables, step=0)
+    return state, loop
+
+  def test_tp2_one_executable_params_actually_sharded(self):
+    state, loop = self._build(tp=2)
+    for _ in range(2):
+      state, metrics = loop.step(state)
+    assert loop.compile_counts == {"anakin_step": 1}
+    assert metrics["trained_steps"] > 0
+    for value in metrics.values():
+      assert np.isfinite(value)
+    sharded = 0
+    bytes_total = 0
+    bytes_replica = 0
+    for leaf in jax.tree_util.tree_leaves(state.params):
+      bytes_total += int(leaf.nbytes)
+      if "model" in _spec_names(leaf.sharding):
+        sharded += 1
+      shard0 = min(leaf.addressable_shards, key=lambda s: s.device.id)
+      bytes_replica += int(shard0.data.nbytes)
+    assert sharded > 0, "no param leaf carries the model axis"
+    # Per-replica param memory genuinely drops (~2x minus the
+    # replicated q head + scalars).
+    assert bytes_replica < 0.75 * bytes_total, (bytes_replica,
+                                                bytes_total)
+    # The carried state re-enters its own compiled call: the donated
+    # AOT boundary held across dispatches (dispatch 2 above), and the
+    # optimizer state kept the param layout.
+    for param, mu in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(state.opt_state[0].mu)):
+      assert param.sharding == mu.sharding
+
+  @pytest.mark.slow
+  def test_tp1_path_has_zero_sharded_leaves(self):
+    state, loop = self._build(tp=1)
+    state, metrics = loop.step(state)
+    assert loop.compile_counts == {"anakin_step": 1}
+    assert metrics["trained_steps"] > 0
+    for leaf in jax.tree_util.tree_leaves(state.params):
+      assert "model" not in _spec_names(leaf.sharding)
+
+
+# -- int8 tier ---------------------------------------------------------------
+
+
+class TestInt8Tier:
+
+  @pytest.fixture(scope="class")
+  def model_and_variables(self):
+    model = TinyQCriticModel(optimizer_fn=lambda: optax.adam(1e-3))
+    return model, model.init_variables(jax.random.key(0))
+
+  def test_quantize_wraps_kernels_with_bounded_roundtrip(
+      self, model_and_variables):
+    from tensor2robot_tpu.research.qtopt import cem
+    _, variables = model_and_variables
+    quantized = cem.cast_scoring_variables(variables, "int8")
+    kernel = variables["params"]["img_fc1"]["kernel"]
+    wrapper = quantized["params"]["img_fc1"]["kernel"]
+    assert set(wrapper) == {cem._QUANT_KEY, cem._SCALE_KEY}
+    assert wrapper[cem._QUANT_KEY].dtype == jnp.int8
+    assert wrapper[cem._SCALE_KEY].dtype == jnp.float32
+    # Per-output-channel symmetric: one scale per output feature, and
+    # the dequantized round-trip lands within half a quantization step.
+    assert wrapper[cem._SCALE_KEY].shape[-1] == kernel.shape[-1]
+    dense = (wrapper[cem._QUANT_KEY].astype(jnp.float32)
+             * wrapper[cem._SCALE_KEY])
+    step = np.asarray(wrapper[cem._SCALE_KEY])
+    err = np.abs(np.asarray(dense) - np.asarray(kernel))
+    assert np.all(err <= 0.5 * step + 1e-7), err.max()
+
+  def test_quantize_is_idempotent(self, model_and_variables):
+    from tensor2robot_tpu.research.qtopt import cem
+    _, variables = model_and_variables
+    once = cem.cast_scoring_variables(variables, "int8")
+    twice = cem.cast_scoring_variables(once, "int8")
+    for a, b in zip(jax.tree_util.tree_leaves(once),
+                    jax.tree_util.tree_leaves(twice)):
+      np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+  def test_scoring_weights_view_dequantizes_dense(
+      self, model_and_variables):
+    from tensor2robot_tpu.research.qtopt import cem
+    _, variables = model_and_variables
+    quantized = cem.cast_scoring_variables(variables, "int8")
+    view = cem.scoring_weights_view(quantized, "int8")
+    kernel = view["params"]["img_fc1"]["kernel"]
+    assert not isinstance(kernel, dict)
+    assert kernel.shape == variables["params"]["img_fc1"]["kernel"].shape
+
+  def test_int8_scores_f32_and_close_to_oracle(self,
+                                               model_and_variables):
+    from tensor2robot_tpu.research.qtopt import cem
+    model, variables = model_and_variables
+    rng = np.random.default_rng(2)
+    image = jnp.asarray(rng.integers(0, 255, (16, 16, 3), np.uint8))
+    actions = jnp.asarray(rng.uniform(-1, 1, (8, 4)).astype(np.float32))
+    s32 = cem.make_tiled_q_score_fn(model.predict_fn, variables)
+    s8 = cem.make_tiled_q_score_fn(model.predict_fn, variables,
+                                   precision="int8")
+    a = jax.jit(s32)(image, actions)
+    b = jax.jit(s8)(image, actions)
+    # Scores return to f32 before top_k; quantization error stays a
+    # VALUE perturbation, never bit parity (see PARITY round-17 note).
+    assert b.dtype == jnp.float32
+    assert float(jnp.max(jnp.abs(a - b))) > 0.0
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0.05)
+
+  def test_host_fallback_names_tier_and_supported_set(self):
+    """Satellite (ISSUE 16): the non-f32 host-fallback refusal must
+    name the requested tier AND the supported set in one round-trip."""
+    from tensor2robot_tpu.research.qtopt import cem
+    from tensor2robot_tpu.serving.bucketing import BucketLadder
+    from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+
+    class _HostOnlyPredictor:
+      def device_fn(self):
+        raise NotImplementedError
+
+    policy = CEMFleetPolicy(
+        _HostOnlyPredictor(), action_size=4, num_samples=8,
+        num_elites=2, iterations=1, seed=0, ladder=BucketLadder((2,)),
+        precision="int8")
+    frames = [np.zeros((16, 16, 3), np.uint8)] * 2
+    with pytest.raises(ValueError) as info:
+      policy(frames, np.arange(2, dtype=np.uint32))
+    message = str(info.value)
+    assert "'int8'" in message
+    assert str(cem.SCORING_PRECISIONS) in message
+
+
+# -- tp-sharded checkpoint round trip ----------------------------------------
+
+
+class TestTPCheckpointRoundTrip:
+  """Satellite (ISSUE 16): a TP-sharded TrainState survives
+  save/restore with its layout intact; a geometry-changed resume
+  refuses up front with the nearest fix named."""
+
+  def _trainer(self, tp=2):
+    from tensor2robot_tpu.parallel import tp_rules
+    from tensor2robot_tpu.train.trainer import Trainer
+    model = TPTinyQCriticModel(image_size=IMG,
+                               optimizer_fn=lambda: optax.adam(1e-3))
+    mesh = _mesh({"data": 1, "model": tp})
+    specs = tp_rules.partition_specs_for_model(model, mesh)
+    return Trainer(model, mesh=mesh, seed=0, param_specs=specs)
+
+  def test_sharded_state_roundtrips_with_layout(self, tmp_path):
+    from tensor2robot_tpu.train import checkpoints
+    trainer = self._trainer()
+    state = trainer.create_train_state(batch_size=8)
+    manager = checkpoints.CheckpointManager(
+        str(tmp_path), async_checkpointing=False)
+    manager.save(0, state, force=True)
+    manager.wait()
+    template = trainer.create_train_state(batch_size=8)
+    restored = manager.restore(template, step=0)
+    manager.close()
+    for saved, back in zip(jax.tree_util.tree_leaves(state),
+                           jax.tree_util.tree_leaves(restored)):
+      np.testing.assert_array_equal(np.asarray(saved), np.asarray(back))
+    kernel = restored.params["img_fc1"]["kernel"]
+    assert "model" in _spec_names(kernel.sharding)
+
+  def test_mesh_geometry_refusal_names_both_and_the_fix(self):
+    from tensor2robot_tpu.train import checkpoints
+    stamp = checkpoints.mesh_geometry(_mesh({"data": 1, "model": 2}))
+    assert stamp == {"axes": {"data": 1, "model": 2}, "devices": 2}
+    with pytest.raises(ValueError) as info:
+      checkpoints.validate_restore_mesh(stamp,
+                                        _mesh({"data": 2, "model": 1}))
+    message = str(info.value)
+    assert "'model': 2" in message and "'model': 1" in message
+    assert "data=1 x model=2" in message  # the nearest fix, named
+    # Same geometry passes; a pre-stamp checkpoint (None) passes.
+    checkpoints.validate_restore_mesh(stamp,
+                                      _mesh({"data": 1, "model": 2}))
+    checkpoints.validate_restore_mesh(None, _mesh({"data": 2}))
+
+  @pytest.mark.slow
+  def test_loop_resume_on_changed_mesh_refuses(self, tmp_path):
+    from tensor2robot_tpu.replay.loop import (ReplayLoopConfig,
+                                              ReplayTrainLoop)
+
+    def make_loop(mesh_dp, resume):
+      config = ReplayLoopConfig(seed=0, checkpoint_every=10,
+                                resume=resume, eval_every=10,
+                                mesh_dp=mesh_dp, mesh_tp=1)
+      model = TinyQCriticModel(
+          image_size=config.image_size,
+          action_size=config.action_size,
+          optimizer_fn=lambda: optax.adam(config.learning_rate))
+      return ReplayTrainLoop(config, str(tmp_path), model=model)
+
+    make_loop(mesh_dp=1, resume=False).run(10)
+    with pytest.raises(ValueError,
+                       match=r"resume mesh geometry mismatch"):
+      make_loop(mesh_dp=2, resume=True).run(10)
+
+
+# -- health baselines through the sidecar ------------------------------------
+
+
+class TestHealthBaselineResume:
+  """Satellite (ISSUE 16): EWMA drift baselines persist in the
+  checkpoint sidecar and re-seat on resume — no post-restart
+  drift-blindness window."""
+
+  def test_monitor_state_dict_roundtrip(self):
+    from tensor2robot_tpu.obs import health as health_lib
+    monitor = health_lib.HealthMonitor(rules=health_lib.default_rules())
+    rng = np.random.default_rng(0)
+    for step in range(1, 16):
+      monitor.observe(step, {
+          "health/nonfinite_grads": 0.0,
+          "health/nonfinite_params": 0.0,
+          "health/nonfinite_targets": 0.0,
+          "health/grad_norm": 1.0 + 0.01 * rng.random(),
+          "health/td_mean": 0.5 + 0.01 * rng.random(),
+          "health/q_max": 2.0,
+          "health/priority_entropy": 0.9,
+      })
+    saved = monitor.state_dict()
+    assert saved["observations"] == 15
+    assert any(entry[0] > 0 for entry in saved["drift"].values())
+    fresh = health_lib.HealthMonitor(rules=health_lib.default_rules())
+    fresh.load_state_dict(saved)
+    assert fresh.state_dict() == saved
+    # JSON-able: the sidecar meta is serialized as JSON.
+    assert json.loads(json.dumps(saved)) == saved
+
+  def test_load_ignores_unknown_rules_keeps_known(self):
+    from tensor2robot_tpu.obs import health as health_lib
+    monitor = health_lib.HealthMonitor(rules=health_lib.default_rules())
+    monitor.load_state_dict({
+        "drift": {"td_drift": [7, 0.5, 0.01],
+                  "retired_rule": [99, 1.0, 1.0]},
+        "seen": {"td_drift": 7, "retired_rule": 99},
+        "observations": 7,
+    })
+    state = monitor.state_dict()
+    assert state["drift"]["td_drift"] == [7, 0.5, 0.01]
+    assert "retired_rule" not in state["drift"]
+    assert state["observations"] == 7
+
+  @pytest.mark.slow
+  def test_loop_persists_and_reseats_baselines(self, tmp_path):
+    from tensor2robot_tpu.replay.loop import (ReplayLoopConfig,
+                                              ReplayTrainLoop)
+    from tensor2robot_tpu.train import checkpoints as checkpoints_lib
+
+    def make_loop(resume):
+      config = ReplayLoopConfig(seed=0, checkpoint_every=10,
+                                resume=resume, eval_every=10,
+                                mesh_dp=1, mesh_tp=1)
+      model = TinyQCriticModel(
+          image_size=config.image_size,
+          action_size=config.action_size,
+          optimizer_fn=lambda: optax.adam(config.learning_rate))
+      return ReplayTrainLoop(config, str(tmp_path), model=model)
+
+    loop_a = make_loop(resume=False)
+    loop_a.run(10)
+    root = loop_a.checkpoint_root
+    _, _, meta = checkpoints_lib.load_sidecar(root, 10)
+    saved = meta.get("health")
+    assert saved, "drift baselines missing from the checkpoint sidecar"
+    assert saved["observations"] > 0
+    loop_b = make_loop(resume=True)
+    result = loop_b.run(20)
+    assert result["steps"] == 20
+    # The resumed monitor continued FROM the saved baselines: at least
+    # as many observations as the checkpoint carried, never re-zeroed.
+    resumed = loop_b.health_monitor.state_dict()
+    assert resumed["observations"] >= saved["observations"]
+    for name, entry in saved["drift"].items():
+      assert resumed["drift"][name][0] >= entry[0], name
+
+
+# -- committed artifact + CLI ------------------------------------------------
+
+
+class TestCommittedTPQuantArtifact:
+  """TPQUANT_r17.json was generated with enforce_bars=True; this
+  re-validates the committed copy against every bar so a hand-edited
+  or stale artifact fails tier-1."""
+
+  @pytest.fixture(scope="class")
+  def artifact(self):
+    path = os.path.join(ROOT, "TPQUANT_r17.json")
+    assert os.path.exists(path), "committed TPQUANT_r17.json missing"
+    with open(path) as f:
+      return json.load(f)
+
+  def test_tp_ladder_rungs_sharded_through_one_executable(self,
+                                                          artifact):
+    assert artifact["round"] == 17
+    rungs = artifact["tp"]["rungs"]
+    assert set(rungs) == {"1", "2", "4", "8"}
+    for tp_key, rung in rungs.items():
+      tp = int(tp_key)
+      assert rung["anakin_step_compiles"] == 1, rung
+      assert rung["ledger_all_one"] is True
+      sharding = rung["param_sharding"]
+      if tp == 1:
+        assert sharding["model_sharded_leaves"] == 0
+        assert rung["replica_bytes_factor"] == 1.0
+      else:
+        assert sharding["model_sharded_leaves"] > 0
+        assert rung["replica_bytes_factor"] >= 0.9 * tp, rung
+
+  def test_tp1_oracle_is_bitwise(self, artifact):
+    oracle = artifact["tp"]["tp1_oracle"]
+    assert oracle["bitwise_equal"] is True
+    assert oracle["model_sharded_leaves"] == 0
+
+  def test_int8_bars(self, artifact):
+    agreement = artifact["int8_agreement"]
+    assert agreement["overall_rate"] >= artifact["int8_agreement_bar"]
+    assert artifact["int8_agreement_bar"] >= 0.99
+    for bucket in agreement["per_bucket"].values():
+      assert bucket["pairs"] > 0
+    reduction = artifact["int8_bytes_reduction"]
+    assert reduction["flagship"] >= artifact["int8_bytes_reduction_bar"]
+    assert artifact["int8_bytes_reduction_bar"] >= 3.0
+
+  def test_per_tier_ledger_and_rollout_cycle(self, artifact):
+    ledger = artifact["tier_ledger"]
+    assert ledger["per_tier_exactly_once"] is True
+    counts = ledger["compile_counts"]
+    assert all(value == 1 for value in counts.values()), counts
+    assert any(key.endswith("_int8") for key in counts)
+    assert {"f32", "int8"} <= set(ledger["tier_shares"])
+    rollout = artifact["rollout"]
+    assert rollout["breach_rolled_back"] is True
+    assert rollout["precision_served"] == "int8"
+    assert rollout["cycle_ok"] is True
+    assert rollout["events"] == ["shadow_start", "auto_rollback",
+                                 "shadow_start", "canary_start",
+                                 "promote"]
+    fleet_counts = rollout["compile_ledger"]
+    assert all(value == 1 for value in fleet_counts.values())
+    assert any("_int8@" in key for key in fleet_counts)
+    assert {"f32", "int8"} <= set(rollout["tier_shares"])
+
+  def test_virtual_mesh_nulls_the_chip_claim(self, artifact):
+    assert artifact["virtual_mesh"] is True
+    assert artifact["tp_scaling_efficiency"] is None
+    assert artifact["int8_q_agreement"] is not None
+    assert artifact["int8_param_bytes_reduction"] is not None
+
+
+@pytest.mark.slow
+class TestTPQuantBenchCLI:
+  """The --ci subprocess protocol: reduced ladder, full structure."""
+
+  def test_ci_lane_subprocess(self, tmp_path):
+    out = os.path.join(str(tmp_path), "tpquant_ci.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tensor2robot_tpu.replay.tpquant_bench",
+         "--ci", "--out", out],
+        capture_output=True, text=True, timeout=2400,
+        cwd=ROOT, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    with open(out) as f:
+      result = json.load(f)
+    assert set(result["tp"]["rungs"]) == {"1", "2"}
+    rung2 = result["tp"]["rungs"]["2"]
+    assert rung2["anakin_step_compiles"] == 1
+    assert rung2["param_sharding"]["model_sharded_leaves"] > 0
+    assert result["tp"]["tp1_oracle"]["bitwise_equal"] is True
+    assert result["int8_agreement"]["overall_rate"] >= 0.9
+    assert result["rollout"]["cycle_ok"] is True

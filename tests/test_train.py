@@ -351,10 +351,25 @@ class TestShardedOptimizerState:
     eval_metrics = t_zero.eval_step(state_z, features, labels)
     assert np.isfinite(float(eval_metrics["loss"]))
 
-  def test_rejects_tp_combination(self):
-    with pytest.raises(ValueError, match="pure DP"):
-      Trainer(MockT2RModel(), param_specs={},
-              shard_optimizer_state=True)
+  def test_tp_combination_composes(self):
+    """Until round 17 `param_specs` + `shard_optimizer_state` was
+    refused outright ("pure DP"); rule-partitioned TP made the two
+    layouts compose.  With an all-replicated spec prefix the composed
+    layout reduces exactly to the pure-DP ZeRO-1 rule — same sharded
+    opt state, params still replicated.  (The genuinely two-axis
+    layout is proven in tests/test_tpquant.py.)"""
+    from jax.sharding import PartitionSpec
+    trainer = Trainer(MockT2RModel(hidden_size=64),
+                      param_specs=PartitionSpec(),
+                      shard_optimizer_state=True)
+    state = trainer.create_train_state()
+    assert all(leaf.sharding.is_fully_replicated
+               for leaf in jax.tree_util.tree_leaves(state.params))
+    sharded = [
+        leaf for leaf in jax.tree_util.tree_leaves(state.opt_state)
+        if hasattr(leaf, "sharding")
+        and not leaf.sharding.is_fully_replicated]
+    assert sharded, "no optimizer-state leaf was data-sharded"
 
 
 class TestCheckpoints:
