@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 17
+ROUND = 18
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -1115,6 +1115,27 @@ def _bench_tpquant_compact():
       rollout_cycle_s=60.0, enforce_bars=False)
 
 
+def _bench_flywheel_compact():
+  """Data-flywheel block for the bench detail (ISSUE 18).
+
+  The committed chipless artifact (FLYWHEEL_r18.json) carries the full
+  protocol — the spec-validated ingest gate refusing malformed served
+  episodes by field name, the closed serve→collect→train→redeploy loop
+  (synthetic collectors retired at cutover, >= 2 live promote cycles
+  mid-run, per-transition correlation ids reconciled against the
+  router's logical-request counter, staleness/coverage/mix interlock
+  green) and the stale-params control whose severed export path MUST
+  breach — where improvement and cycle ORDERING are the chipless
+  claims. This block is the driver-refreshable real-chip counterpart:
+  a reduced loop on the window's devices, where serving and ingest
+  THROUGHPUT become chip numbers instead of the chipless caveat.
+  """
+  from tensor2robot_tpu.flywheel.flywheel_bench import measure_flywheel
+  return measure_flywheel(
+      warm_steps=16, fleet_steps=30, export_every=15,
+      control_fleet_steps=60, enforce_bars=False)
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1296,6 +1317,11 @@ def main() -> None:
   except Exception as e:
     tpquant = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    flywheel = _bench_flywheel_compact()
+  except Exception as e:
+    flywheel = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1360,6 +1386,7 @@ def main() -> None:
       "faults": faults,
       "health": health,
       "tpquant": tpquant,
+      "flywheel": flywheel,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1447,6 +1474,17 @@ def main() -> None:
       "int8_q_agreement": tpquant.get("int8_q_agreement"),
       "int8_param_bytes_reduction": tpquant.get(
           "int8_param_bytes_reduction"),
+      # Data-flywheel sentinels (ISSUE 18): the closed loop's policy
+      # improvement with synthetic collection retired at cutover (the
+      # learner trained ONLY on what the fleet served — meaningful on
+      # any backend: structure, not timing), and whether the ingested-
+      # stream interlock held — the healthy run's staleness/coverage/
+      # mix rules green AND the stale-params control breaching. Null-
+      # safe under outage/error like every compact key.
+      "flywheel_policy_improvement": flywheel.get(
+          "flywheel_policy_improvement"),
+      "flywheel_ingest_health_ok": flywheel.get(
+          "flywheel_ingest_health_ok"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,
   }))

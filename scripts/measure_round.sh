@@ -306,6 +306,27 @@ else
     python -m tensor2robot_tpu.replay.tpquant_bench --smoke \
       --out "$STAGE_TMP"'
 fi
+# Tenth chipless backstop (ISSUE 18): the data-flywheel protocol — the
+# spec-validated ingest gate (malformed served episodes refused with
+# the field named), the closed serve→collect→train→redeploy loop with
+# synthetic collectors retired at cutover and >= 2 live promote cycles
+# mid-run, per-transition correlation ids reconciled against the
+# router's logical-request counter, the staleness/coverage/mix
+# interlock green, and the stale-params control whose severed export
+# path must breach. Same tmp→mv atomicity and pytest deferral rules
+# (its promote cycles and client pacing are wall-clock sensitive).
+if [ -s "FLYWHEEL_${RTAG}.json" ]; then
+  log "skip FLYWHEEL_${RTAG}.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring flywheel backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "FLYWHEEL_${RTAG}.json" 3000 sh -c '
+    python -m tensor2robot_tpu.bin.bench_flywheel --smoke \
+      --out "$STAGE_TMP"'
+fi
 while [ "$(date +%s)" -lt "$deadline" ]; do
   # Never perturb a live test run: the probe's jax import is real CPU
   # on a small host, and the serving smoke's amortization bar is a

@@ -1,0 +1,25 @@
+"""Data-flywheel benchmark CLI: the bin/ face of flywheel/flywheel_bench.
+
+    # The committed FLYWHEEL_r18 protocol (chipless: the CLI bootstraps
+    # an 8-virtual-device CPU mesh and re-execs itself; acceptance bars
+    # are ENFORCED at generation time):
+    python -m tensor2robot_tpu.bin.bench_flywheel --smoke --out FLYWHEEL_r18.json
+
+    # Reduced tier-1 lane (2 devices, short phases, same structure):
+    python -m tensor2robot_tpu.bin.bench_flywheel --ci
+
+Everything — the spec-validated ingest gate (malformed served episodes
+refused with the field named), the closed serve→collect→train→redeploy
+loop with synthetic collectors retired at cutover and ≥ 2 live promote
+cycles mid-run, per-transition correlation-id traceability reconciled
+against the router's logical-request counter, the staleness/coverage/
+mix interlock, and the stale-params control whose severed export path
+must breach — lives in flywheel/flywheel_bench.py; this wrapper exists
+so the flywheel protocol is discoverable next to bench_fleet in the
+bin/ surface every other measured artifact is produced from.
+"""
+
+from tensor2robot_tpu.flywheel.flywheel_bench import main
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,338 @@
+"""Episode capture at the serving seam + the spec-validated ingest gate.
+
+Two halves of ISSUE 18's data path:
+
+**EpisodeRecorder** hooks ``PolicyReplica._flush`` (the router passes it
+down at construction): per served request it logs the scene image, the
+CEM seed, the action the fleet ACTUALLY answered with (post-fault — the
+seam is the truth, not the client's view), the serving params version
+the dispatch ran under, and the request's correlation id (ISSUE 12; the
+batcher binds the batch's ids in item order before calling the flush).
+The flywheel's episode driver then waits on its request id to close the
+transition against the env-dynamics oracle.
+
+**FlywheelIngest** is the door back into the replay ring: a completed
+episode re-enters ONLY through the same ``specs/tensorspec_utils``
+validation the synthetic collectors' transitions pass (the spec system
+types both sides by design). A malformed episode — shape/dtype drift, a
+missing outcome stream, a transition without its correlation id — is
+REFUSED with the offending field named: the gate raises
+``IngestRejected``, counts it, and fires a ``flywheel_ingest_rejected``
+flight-recorder dump; nothing is ever silently dropped. Accepted
+episodes enqueue provenance-tagged ("served") and feed the staleness /
+coverage / mix health metrics the sentinel rules watch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tensor2robot_tpu.obs import context as context_lib
+from tensor2robot_tpu.obs import flight_recorder as flight_lib
+from tensor2robot_tpu.obs import registry as registry_lib
+from tensor2robot_tpu.obs.health import HealthRule
+from tensor2robot_tpu.replay.ingest import (TRANSITION_KEYS,
+                                            TransitionQueue,
+                                            episode_to_transitions)
+from tensor2robot_tpu.replay.ring_buffer import _validate_against_spec
+from tensor2robot_tpu.specs import tensorspec_utils as ts
+
+
+@dataclasses.dataclass
+class ServedRecord:
+  """What the serving seam knew about one answered request."""
+
+  request_id: str
+  image: np.ndarray
+  seed: int
+  action: np.ndarray
+  params_version: Optional[int]
+  device: str
+  t_s: float
+
+
+class EpisodeRecorder:
+  """Thread-safe capture buffer keyed by request correlation id.
+
+  ``record_served`` runs on replica dispatcher threads (inside
+  ``_flush``, exception-isolated there); ``wait_for`` runs on the
+  episode driver's thread and blocks until the request's record lands
+  (a canary-phase live mirror can resolve after the client's own
+  future). First capture per id wins: a router retry that re-flushes a
+  request records a DUPLICATE (counted, not stored) — the first flush's
+  action is the one whose answer the client received. Pending records
+  are bounded FIFO (``max_pending``): an id nobody ever collects (a
+  shed client, a crashed driver) is evicted oldest-first and counted.
+  """
+
+  def __init__(self, max_pending: int = 4096):
+    if max_pending < 1:
+      raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+    self._max_pending = max_pending
+    self._records: "OrderedDict[str, ServedRecord]" = OrderedDict()
+    self._cond = threading.Condition()
+    self._epoch = time.perf_counter()
+    self.captured = 0      # unique request ids recorded
+    self.duplicates = 0    # repeat captures for an already-held id
+    self.unattributed = 0  # batch items with no bound request id
+    self.evicted = 0       # never-collected records shed by the bound
+    self.collected = 0     # records handed to a waiter
+
+  def record_served(self, items: Sequence, actions, device: str,
+                    params_version: Optional[int] = None) -> int:
+    """Captures one flushed batch; returns newly recorded count.
+
+    ``items`` are the batcher's (image, seed) tuples in batch order;
+    the bound ``request_ids`` context attr (comma-joined by the
+    batcher, same order) attributes each item to its request.
+    """
+    joined = context_lib.context_attrs().get("request_ids") or ""
+    ids = joined.split(",") if joined else []
+    now = time.perf_counter() - self._epoch
+    fresh = 0
+    with self._cond:
+      for i, (item, action) in enumerate(zip(items, actions)):
+        request_id = ids[i] if i < len(ids) and ids[i] else None
+        if request_id is None:
+          self.unattributed += 1
+          continue
+        if request_id in self._records:
+          self.duplicates += 1
+          continue
+        self._records[request_id] = ServedRecord(
+            request_id=request_id,
+            image=np.asarray(item[0]),
+            seed=int(item[1]),
+            action=np.array(action, np.float32, copy=True),
+            params_version=(None if params_version is None
+                            else int(params_version)),
+            device=device,
+            t_s=round(now, 6))
+        self.captured += 1
+        fresh += 1
+      while len(self._records) > self._max_pending:
+        self._records.popitem(last=False)
+        self.evicted += 1
+      if fresh:
+        self._cond.notify_all()
+    return fresh
+
+  def wait_for(self, request_id: str,
+               timeout: float = 5.0) -> Optional[ServedRecord]:
+    """Pops the id's record, blocking up to ``timeout``; None on miss
+    (a shed request never flushes, so its record never arrives)."""
+    deadline = time.monotonic() + timeout
+    with self._cond:
+      while True:
+        record = self._records.pop(request_id, None)
+        if record is not None:
+          self.collected += 1
+          return record
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+          return None
+        self._cond.wait(remaining)
+
+  def pending(self) -> int:
+    with self._cond:
+      return len(self._records)
+
+  def snapshot(self) -> Dict[str, int]:
+    with self._cond:
+      return {
+          "captured": self.captured,
+          "collected": self.collected,
+          "duplicates": self.duplicates,
+          "unattributed": self.unattributed,
+          "evicted": self.evicted,
+          "pending": len(self._records),
+      }
+
+
+class IngestRejected(ValueError):
+  """A served episode refused at the ingest gate, offending field named."""
+
+  def __init__(self, field: str, detail: str):
+    self.field = field
+    self.detail = detail
+    super().__init__(
+        f"served episode refused at ingest ({field}): {detail}")
+
+
+class FlywheelIngest:
+  """Spec-validated door from closed episodes back into the replay ring.
+
+  Every accepted transition is traceable: the gate requires one
+  correlation id and one serving-params version PER STEP, measures the
+  params-version lag against the learner's current step (the staleness
+  metric), and enqueues the validated batch provenance-tagged
+  ("served") so the ring's mix ledger stays exact. Refusals raise
+  ``IngestRejected`` with the field named — the caller decides what to
+  do with the episode, but the gate never eats one silently.
+  """
+
+  def __init__(self, queue: TransitionQueue, transition_spec,
+               learner_step_fn, monitor=None,
+               registry: Optional[registry_lib.MetricRegistry] = None,
+               flight_recorder=None, coverage_window: int = 32):
+    self._queue = queue
+    self._spec = ts.flatten_spec_structure(transition_spec)
+    self._learner_step_fn = learner_step_fn
+    self._monitor = monitor
+    self._registry = registry or registry_lib.get_registry()
+    self._recorder = flight_recorder or flight_lib.get_recorder()
+    self._lock = threading.Lock()
+    # Per-scene coverage over the most recent episodes: a fleet stuck
+    # replaying one scene (a poisoned or looping client) collapses this
+    # to 1 while every per-episode check still passes.
+    self._scene_window: deque = deque(maxlen=coverage_window)
+    self._request_ids: set = set()
+    self._baseline_enqueued = 0
+    self.episodes_ingested = 0
+    self.transitions_ingested = 0
+    self.rejected = 0
+    self.max_staleness_lag = 0
+    self.last_staleness_lag = 0
+
+  def mark_cutover(self) -> None:
+    """Snapshots the queue's enqueue counter as the mix baseline.
+
+    The served-mix rule bounds the served share of what entered the
+    queue SINCE CUTOVER — the warm-start phase legitimately enqueues
+    thousands of synthetic rows, and folding them into the denominator
+    forever would make the mix floor unreachable on a healthy run.
+    After cutover the synthetic collectors are off, so anything
+    diluting the post-cutover stream is exactly what the rule exists
+    to catch."""
+    with self._lock:
+      self._baseline_enqueued = self._queue.stats()["enqueued"]
+
+  def _reject(self, field: str, detail: str, scene_seed) -> None:
+    with self._lock:
+      self.rejected += 1
+    self._registry.counter("flywheel/ingest_rejected").inc()
+    self._recorder.trigger("flywheel_ingest_rejected", field=field,
+                           detail=detail, scene_seed=int(scene_seed))
+    raise IngestRejected(field, detail)
+
+  def submit_episode(self, episode, *, scene_seed: int,
+                     request_ids: Sequence[str],
+                     params_versions: Sequence[Optional[int]],
+                     provenance: str = "served") -> int:
+    """Validates + enqueues one closed episode; returns transitions.
+
+    Raises IngestRejected (field named) on: a step missing its
+    correlation id or params version, episode streams disagreeing on
+    length (the missing-outcome case: a served action whose reward/done
+    never closed), or any spec key/shape/dtype mismatch.
+    """
+    actions = np.asarray(episode.get("actions", ()))
+    steps = len(actions)
+    request_ids = list(request_ids)
+    params_versions = list(params_versions)
+    if len(request_ids) != steps or any(not rid for rid in request_ids):
+      self._reject(
+          "request_ids",
+          f"{len(request_ids)} correlation id(s) for {steps} step(s); "
+          "every served transition must carry its originating "
+          "request's id", scene_seed)
+    if (len(params_versions) != steps
+        or any(v is None for v in params_versions)):
+      self._reject(
+          "params_versions",
+          f"{len(params_versions)} params version(s) for {steps} "
+          "step(s); staleness lag needs the serving version per step",
+          scene_seed)
+    try:
+      transitions = episode_to_transitions(episode)
+    except (ValueError, KeyError) as e:
+      self._reject("episode_streams", str(e), scene_seed)
+    batch = {key: np.stack([t[key] for t in transitions])
+             for key in TRANSITION_KEYS}
+    try:
+      batch = _validate_against_spec(self._spec, batch, batched=True)
+    except ValueError as e:
+      detail = str(e)
+      field = next((key for key in self._spec
+                    if detail.startswith(f"{key}:")), "spec_keys")
+      self._reject(field, detail, scene_seed)
+
+    self._queue.put_batch(batch, provenance=provenance)
+    step = int(self._learner_step_fn())
+    lag = step - min(int(v) for v in params_versions)
+    with self._lock:
+      self.episodes_ingested += 1
+      self.transitions_ingested += steps
+      self._request_ids.update(request_ids)
+      self._scene_window.append(int(scene_seed))
+      coverage = len(set(self._scene_window))
+      served = self.transitions_ingested
+      self.last_staleness_lag = lag
+      self.max_staleness_lag = max(self.max_staleness_lag, lag)
+    total = max(
+        self._queue.stats()["enqueued"] - self._baseline_enqueued, 1)
+    metrics = {
+        "flywheel/staleness_lag": float(lag),
+        "flywheel/scene_coverage": float(coverage),
+        "flywheel/served_fraction": served / total,
+    }
+    for name, value in metrics.items():
+      self._registry.gauge(name).set(value)
+    if self._monitor is not None:
+      # Cross-thread safe: HealthMonitor.observe is lock-guarded, and
+      # the ingest tick is the right observation point — the interlock
+      # must fire on what ENTERS the learner, not on a wall clock.
+      self._monitor.observe(step, metrics)
+    return steps
+
+  def unique_request_ids(self) -> int:
+    with self._lock:
+      return len(self._request_ids)
+
+  def snapshot(self) -> Dict[str, float]:
+    with self._lock:
+      return {
+          "episodes_ingested": self.episodes_ingested,
+          "transitions_ingested": self.transitions_ingested,
+          "rejected": self.rejected,
+          "unique_request_ids": len(self._request_ids),
+          "scene_coverage_window": len(set(self._scene_window)),
+          "last_staleness_lag": self.last_staleness_lag,
+          "max_staleness_lag": self.max_staleness_lag,
+      }
+
+
+def flywheel_rules(staleness_ceiling: float,
+                   coverage_floor: float = 4.0,
+                   served_mix_floor: float = 0.05,
+                   coverage_warmup: int = 8,
+                   mix_warmup: int = 16) -> List[HealthRule]:
+  """The ingested-stream interlock (wired into the ISSUE 12 sentinel).
+
+  - staleness ceiling: ingested transitions were served by params more
+    than ``staleness_ceiling`` learner steps behind — the promote path
+    has stalled and the flywheel is feeding on its own stale output
+    (warmup 0: the FIRST stale episode is already evidence);
+  - per-scene coverage floor: distinct scenes over the recent episode
+    window collapsed — a looping or poisoned data source;
+  - served-vs-synthetic mix floor: the served share of everything
+    enqueued since cutover (``FlywheelIngest.mark_cutover``) fell —
+    some non-fleet source is still filling the ring after the
+    synthetic collectors were supposedly retired.
+  """
+  return [
+      HealthRule("flywheel_staleness_ceiling", "flywheel/staleness_lag",
+                 kind="max", limit=float(staleness_ceiling), warmup=0),
+      HealthRule("flywheel_scene_coverage_floor",
+                 "flywheel/scene_coverage", kind="min",
+                 limit=float(coverage_floor), warmup=coverage_warmup),
+      HealthRule("flywheel_served_mix_floor", "flywheel/served_fraction",
+                 kind="min", limit=float(served_mix_floor),
+                 warmup=mix_warmup),
+  ]

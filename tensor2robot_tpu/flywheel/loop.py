@@ -1,0 +1,706 @@
+"""The closed serve→collect→train→redeploy cycle (ISSUE 18 tentpole).
+
+``FlywheelLoop.run()`` drives one continuous flywheel on the virtual
+mesh:
+
+1. **Warm start** — synthetic ``CollectorWorker`` traffic (provenance
+   "synthetic") fills the ring and the learner trains to mid-descent,
+   exactly the PR 2 host loop. Then the collectors stop, PERMANENTLY.
+2. **Cutover** — the warm-started params deploy to the serving fleet
+   (``set_variables`` with the warm step as the version: the fleet
+   serves what the learner just trained).
+3. **Fleet phase** — a ``FleetClient`` drives grasp episodes through
+   ``RolloutController.submit`` like any other client; the
+   ``EpisodeRecorder`` at the replica flush seam captures what the
+   fleet served; the client closes each served action against the env
+   dynamics oracle (``GraspRetryEnv`` — per-request outcomes, the
+   QT-Opt robot stand-in) and re-ingests the episode through the
+   spec-validated ``FlywheelIngest`` gate (provenance "served"). The
+   learner keeps training — now ONLY on fleet-served traffic arriving
+   through the same TransitionQueue → replay ring path — and exports
+   every ``export_every`` steps through ``ExportWatcher`` →
+   shadow → canary → promote, so a promoted checkpoint immediately
+   changes the data it will later train on.
+
+The stale-params control (``promotes=False``) severs step 3's export
+path: the fleet serves the warm-start params forever while the learner
+advances, and the staleness-ceiling HealthRule must breach — the
+poisoning interlock's positive test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.flywheel.capture import (EpisodeRecorder,
+                                               FlywheelIngest,
+                                               IngestRejected,
+                                               flywheel_rules)
+from tensor2robot_tpu.obs import context as context_lib
+from tensor2robot_tpu.obs import flight_recorder as flight_lib
+from tensor2robot_tpu.obs import registry as registry_lib
+from tensor2robot_tpu.obs.health import HealthMonitor
+from tensor2robot_tpu.replay.ingest import ReplayFeeder, TransitionQueue
+from tensor2robot_tpu.replay.ring_buffer import ShardedReplayBuffer
+from tensor2robot_tpu.serving.slo import SLOClass
+
+
+@dataclasses.dataclass
+class FlywheelConfig:
+  """Knobs for one flywheel run (defaults: chipless CI smoke scale)."""
+
+  image_size: int = 16
+  action_size: int = 4
+  batch_size: int = 32
+  capacity: int = 1024
+  min_fill: int = 96
+  num_buffer_shards: int = 2
+  prioritized: bool = True
+  gamma: float = 0.8
+  learning_rate: float = 3e-3
+  cem_num_samples: int = 16
+  cem_num_elites: int = 4
+  cem_iterations: int = 2
+  max_attempts: int = 3
+  grasp_radius: float = 0.4
+  queue_capacity: int = 1024
+  # Phase lengths (learner optimizer steps).
+  warm_steps: int = 60
+  fleet_steps: int = 120
+  refresh_every: int = 15
+  eval_batches: int = 4
+  export_every: int = 30
+  # Warm-start synthetic collection (OFF after cutover, by design).
+  warm_envs: int = 4
+  exploration_epsilon: float = 0.25
+  scripted_fraction: float = 0.25
+  # Serving fleet.
+  num_fleet_devices: Optional[int] = None  # None = every visible device
+  ladder_sizes: Tuple[int, ...] = (1, 2)
+  deadline_ms: float = 500.0               # client SLO budget
+  record_timeout_s: float = 10.0
+  client_pace_s: float = 0.0
+  # Rollout gate (deliberately fast cycles: the flywheel bench proves
+  # the LOOP closes, not the gate's sharpness — PR 7/10 own that).
+  mirror_fraction: float = 1.0
+  canary_fraction: float = 0.5
+  min_shadow_samples: int = 12
+  min_canary_samples: int = 6
+  # The rollout q bar scores the CANDIDATE's actions under the LIVE
+  # serving critic (rollout.py) — a parity bar, right for same-params
+  # tier candidates. Between SUCCESSIVE learner checkpoints it reads
+  # Bellman contraction as regression: the warm-start critic
+  # overestimates Q, so a better-trained candidate's argmax actions
+  # legitimately score ~0.3-0.45 LOWER under the stale oracle
+  # (observed q_delta_mean over the smoke protocol). 0.75 clears that
+  # drift band while still rolling back a candidate whose actions the
+  # serving oracle scores as catastrophic.
+  max_q_regression: float = 0.75
+  promote_timeout_s: float = 120.0
+  # Ingest health interlock.
+  staleness_ceiling: Optional[float] = None  # None → 2*export_every + 15
+  coverage_floor: float = 4.0
+  served_mix_floor: float = 0.05
+  coverage_window: int = 32
+  # False = the injected stale-params control: no exports, no promotes;
+  # the staleness rule must breach.
+  promotes: bool = True
+  seed: int = 0
+  workdir: Optional[str] = None  # export root + flightrec dumps
+
+  def resolved_staleness_ceiling(self) -> float:
+    if self.staleness_ceiling is not None:
+      return float(self.staleness_ceiling)
+    # Healthy bound: the serving version trails the learner by at most
+    # one export interval (the learner gates on the rollout verdict),
+    # and the metric takes the episode's OLDEST version — an episode
+    # whose first request was served just before a promote and which
+    # closes late in the next export interval carries ~2 intervals of
+    # lag. Two intervals plus margin separates "promote path alive"
+    # from "flywheel feeding on stale output".
+    return float(2 * self.export_every + 15)
+
+
+class FleetClient:
+  """Episode driver + outcome closer: the fleet's user AND its sensor.
+
+  One thread playing grasp episodes against the serving fleet: per
+  attempt it mints a correlation id, submits the scene through the
+  controller (exactly one logical request), waits for the
+  EpisodeRecorder's capture of what the fleet actually served, executes
+  THAT action against the env dynamics (``GraspRetryEnv`` is the
+  outcome oracle — per-request seeds, static scene per episode), and on
+  episode close hands the assembled episode to the ingest gate with its
+  request ids and serving params versions. The capture is the truth: a
+  request whose record never arrives (shed, or its mirror lost) aborts
+  the episode — counted, never fabricated.
+  """
+
+  def __init__(self, submit_fn, recorder: EpisodeRecorder,
+               ingest: FlywheelIngest, *, image_size: int,
+               max_attempts: int, grasp_radius: float, seed: int,
+               slo: Optional[SLOClass] = None,
+               record_timeout_s: float = 10.0, pace_s: float = 0.0,
+               flight_recorder=None):
+    from tensor2robot_tpu.research.qtopt.synthetic_grasping import (
+        GraspRetryEnv)
+    self._submit = submit_fn
+    self._recorder = recorder
+    self._ingest = ingest
+    self._env = GraspRetryEnv(image_size=image_size,
+                              max_attempts=max_attempts,
+                              radius=grasp_radius)
+    self._max_attempts = max_attempts
+    self._seed = seed
+    self._next_scene = 0
+    self._slo = slo
+    self._record_timeout_s = record_timeout_s
+    self._pace_s = pace_s
+    self._flight = flight_recorder or flight_lib.get_recorder()
+    self.requests_submitted = 0
+    self.episodes_closed = 0
+    self.episodes_aborted = 0
+    self.successes = 0
+    self.sheds = 0
+    self.unclosed = 0
+    self.rejected = 0
+    self.errors: List[BaseException] = []
+    self._stop = threading.Event()
+    self._thread = threading.Thread(target=self._run,
+                                    name="flywheel-client", daemon=True)
+
+  def start(self) -> "FleetClient":
+    self._thread.start()
+    return self
+
+  def request_stop(self) -> None:
+    self._stop.set()
+
+  def stop(self, timeout: float = 30.0) -> None:
+    self.request_stop()
+    self._thread.join(timeout)
+    if self.errors:
+      raise RuntimeError("fleet client died") from self.errors[0]
+
+  def _scene_seed(self) -> int:
+    # The CollectorWorker's scene-seed convention, offset so client
+    # scenes never collide with warm-start scenes.
+    seed = (self._seed + 17) * 1_000_003 + self._next_scene
+    self._next_scene += 1
+    return seed
+
+  def _run(self) -> None:
+    try:
+      while not self._stop.is_set():
+        self.play_episode()
+        if self._pace_s:
+          time.sleep(self._pace_s)
+    except BaseException as e:  # noqa: BLE001 — surfaced via stop()
+      self.errors.append(e)
+      self._flight.trigger("collector_thread_exception",
+                           error=f"{type(e).__name__}: {e}",
+                           site="flywheel_client")
+
+  def play_episode(self) -> bool:
+    """One full episode; True when it closed and ingested."""
+    scene_seed = self._scene_seed()
+    self._env.reset(scene_seed)
+    scene = np.asarray(self._env.image)
+    actions, rewards, dones = [], [], []
+    request_ids, params_versions = [], []
+    for _ in range(self._max_attempts):
+      request_id = context_lib.new_request_id()
+      self.requests_submitted += 1
+      try:
+        future = self._submit(scene, slo=self._slo,
+                              request_id=request_id)
+        future.result(timeout=self._record_timeout_s)
+      except Exception:
+        # Shed / timed out / torn down: the fleet never answered, so
+        # there is no served action to execute. Abort the episode.
+        self.sheds += 1
+        self.episodes_aborted += 1
+        return False
+      record = self._recorder.wait_for(request_id,
+                                       timeout=self._record_timeout_s)
+      if record is None:
+        # Answered but never captured (e.g. its canary-phase live
+        # mirror was shed before flushing): without the seam's record
+        # the transition is untraceable — abort, never fabricate.
+        self.unclosed += 1
+        self.episodes_aborted += 1
+        return False
+      action = np.asarray(record.action, np.float32)
+      reward, done, truncated = self._env.step(action)
+      actions.append(action)
+      rewards.append(float(reward))
+      # Bootstrap through truncation: only SUCCESS terminates value
+      # (the CollectorWorker convention).
+      dones.append(float(done))
+      request_ids.append(request_id)
+      params_versions.append(record.params_version)
+      if done or truncated:
+        break
+    episode = {
+        "images": np.stack([scene] * (len(actions) + 1)),
+        "actions": np.stack(actions),
+        "rewards": np.asarray(rewards, np.float32),
+        "dones": np.asarray(dones, np.float32),
+    }
+    try:
+      self._ingest.submit_episode(
+          episode, scene_seed=scene_seed, request_ids=request_ids,
+          params_versions=params_versions, provenance="served")
+    except IngestRejected:
+      self.rejected += 1
+      self.episodes_aborted += 1
+      return False
+    self.episodes_closed += 1
+    self.successes += int(dones[-1] > 0)
+    return True
+
+  def snapshot(self) -> Dict[str, int]:
+    return {
+        "requests_submitted": self.requests_submitted,
+        "episodes_closed": self.episodes_closed,
+        "episodes_aborted": self.episodes_aborted,
+        "successes": self.successes,
+        "sheds": self.sheds,
+        "unclosed": self.unclosed,
+        "rejected": self.rejected,
+    }
+
+
+class FlywheelLoop:
+  """One flywheel run end to end; ``run()`` returns the evidence dict."""
+
+  def __init__(self, config: Optional[FlywheelConfig] = None):
+    self.config = config or FlywheelConfig()
+    self._step = 0
+    self._train_exec = None
+    self.compile_counts: Dict[str, int] = {}
+
+  # -- learner plumbing -----------------------------------------------------
+
+  def _host_variables(self, state):
+    from tensor2robot_tpu.export import export_utils
+    return export_utils.fetch_variables_to_host(
+        state.variables(use_ema=True))
+
+  def _eval_set(self):
+    """Held-out scenes + analytic Q* (the loop.py eval oracle: grasping
+    at the object always succeeds, so Q*(s,a) = 1 if success else
+    gamma; distance to THIS fixed point witnesses learning where the
+    self-consistent Bellman residual cannot)."""
+    from tensor2robot_tpu.research.qtopt import synthetic_grasping as sg
+    c = self.config
+    n = c.batch_size * c.eval_batches
+    images, targets = sg.sample_scenes(
+        n, image_size=c.image_size, seed=c.seed + 990_001,
+        num_distractors=0, occlusion=False)
+    rng = np.random.default_rng(c.seed + 990_002)
+    actions = rng.uniform(-1.0, 1.0,
+                          (n, c.action_size)).astype(np.float32)
+    near = rng.random(n) < 0.5
+    noise = rng.normal(0.0, 0.12, (n, 2)).astype(np.float32)
+    actions[near, :2] = np.clip(targets[near] + noise[near], -1.0, 1.0)
+    success = sg.grasp_success(targets, actions,
+                               c.grasp_radius).astype(np.float32)
+    q_star = np.where(success > 0, 1.0, c.gamma).astype(np.float32)
+    batches, stars = [], []
+    for i in range(c.eval_batches):
+      part = slice(i * c.batch_size, (i + 1) * c.batch_size)
+      batches.append({
+          "image": images[part],
+          "action": actions[part],
+          "reward": success[part],
+          "done": success[part],
+          "next_image": images[part],
+      })
+      stars.append(q_star[part])
+    return batches, stars
+
+  def _eval(self, updater, variables, batches, stars) -> Dict[str, float]:
+    tds = [updater.td_errors(variables, batch, star)
+           for batch, star in zip(batches, stars)]
+    td = np.concatenate(tds)
+    return {"eval_td_error": float(np.mean(td)),
+            "eval_q_loss": float(np.mean(np.square(td)))}
+
+  def _train_tick(self, trainer, state, updater, feeder, buffer, model):
+    feeder.drain()
+    batch, info = buffer.sample()
+    targets, q_next = updater.compute_targets(batch)
+    features = {"image": np.asarray(batch["image"]),
+                "action": np.asarray(batch["action"])}
+    labels = {model.target_key: targets}
+    sharded = trainer.shard_batch((features, labels))
+    if self._train_exec is None:
+      # AOT once at the ring's fixed batch shape: later drift raises in
+      # XLA's executable check instead of recompiling — the flywheel
+      # inherits the loop's exactly-once ledger claim unchanged.
+      self._train_exec = trainer.aot_train_step(state, *sharded)
+      self.compile_counts["train_step"] = (
+          self.compile_counts.get("train_step", 0) + 1)
+    state, metrics = self._train_exec(state, *sharded)
+    online = state.variables(use_ema=True)
+    td = updater.td_errors(online, batch, targets)
+    buffer.update_priorities(info.indices, td)
+    return state, online, {
+        "loss": float(metrics["loss"]),
+        "td_mean": float(np.mean(td)),
+        "q_next_mean": float(np.mean(q_next)),
+    }
+
+  # -- export → watcher hand-off --------------------------------------------
+
+  @staticmethod
+  def _export_step(export_root: str, step: int, host_variables) -> str:
+    """Publishes a STEP-named export dir (tmp → atomic rename).
+
+    Deliberately not export_utils.versioned_export_dir: its unix-time
+    versions would race the watcher's monotonic ``_seen`` against the
+    step numbers the staleness metric needs — here dir name == pushed
+    version == learner step, one number everywhere.
+    """
+    from tensor2robot_tpu.export import export_utils, variables_io
+    from tensor2robot_tpu.export.native_export_generator import (
+        VARIABLES_NPZ)
+    tmp = os.path.join(export_root, f".tmp-{step}")
+    final = os.path.join(export_root, str(step))
+    os.makedirs(tmp, exist_ok=True)
+    variables_io.save_variables(os.path.join(tmp, VARIABLES_NPZ),
+                                host_variables)
+    return export_utils.publish(tmp, final)
+
+  @staticmethod
+  def _await_verdict(controller, since: int, timeout_s: float):
+    """Blocks until the controller records a terminal rollout event
+    (promote | auto_rollback) past timeline index ``since``; returns
+    (event or None, new timeline length). The learner gates its next
+    export interval on the verdict so "≥ 2 promote cycles completed
+    MID-RUN" is a structural property of the run, not a race."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+      events = controller.timeline()
+      for index in range(since, len(events)):
+        if events[index]["event"] in ("promote", "auto_rollback"):
+          return events[index], len(events)
+      time.sleep(0.05)
+    return None, since
+
+  # -- the run --------------------------------------------------------------
+
+  def run(self) -> Dict:
+    import jax
+    import optax
+
+    from tensor2robot_tpu.export import export_utils
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+    from tensor2robot_tpu.replay.bellman import BellmanUpdater
+    from tensor2robot_tpu.replay.loop import (CollectorWorker,
+                                              _HotReloadPredictor,
+                                              transition_spec)
+    from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+    from tensor2robot_tpu.research.qtopt import synthetic_grasping as sg
+    from tensor2robot_tpu.serving.bucketing import BucketLadder
+    from tensor2robot_tpu.serving.policy import CEMFleetPolicy
+    from tensor2robot_tpu.serving.router import FleetRouter
+    from tensor2robot_tpu.serving.rollout import (ExportWatcher,
+                                                  RolloutConfig,
+                                                  RolloutController)
+    from tensor2robot_tpu.serving.stats import ServingStats
+    from tensor2robot_tpu.train.trainer import Trainer
+
+    c = self.config
+    workdir = c.workdir or tempfile.mkdtemp(prefix="flywheel-")
+    export_root = os.path.join(workdir, "exports")
+    os.makedirs(export_root, exist_ok=True)
+    registry = registry_lib.MetricRegistry()
+    flight = flight_lib.FlightRecorder(
+        dump_dir=os.path.join(workdir, "flightrec"))
+
+    devices = list(jax.devices())
+    fleet_devices = (devices if c.num_fleet_devices is None
+                     else devices[:c.num_fleet_devices])
+
+    # Learner: single-device mesh (the serving fleet owns the mesh
+    # story here; the learner side stays shape-stable — pjit paper).
+    model = TinyQCriticModel(
+        image_size=c.image_size, action_size=c.action_size,
+        optimizer_fn=lambda: optax.adam(c.learning_rate))
+    mesh = mesh_lib.create_mesh({"data": 1, "model": 1},
+                                devices=devices[:1])
+    trainer = Trainer(model, mesh=mesh, seed=c.seed)
+    state = trainer.create_train_state(batch_size=c.batch_size)
+    host_variables = self._host_variables(state)
+
+    spec = transition_spec(c.image_size, c.action_size)
+    buffer = ShardedReplayBuffer(
+        spec, c.capacity, c.batch_size, num_shards=c.num_buffer_shards,
+        seed=c.seed + 3, prioritized=c.prioritized)
+    queue = TransitionQueue(c.queue_capacity)
+    feeder = ReplayFeeder(queue, buffer, c.min_fill)
+    updater = BellmanUpdater(
+        model, host_variables, action_size=c.action_size, gamma=c.gamma,
+        num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
+        iterations=c.cem_iterations, seed=c.seed + 13)
+
+    # Warm-start collection policy over ITS OWN hot-reload predictor
+    # (the learner refreshes it; the serving fleet's predictor changes
+    # only via promote — that separation IS the staleness story).
+    collector_predictor = _HotReloadPredictor(model, host_variables)
+    collector_policy = CEMFleetPolicy(
+        collector_predictor, action_size=c.action_size,
+        num_samples=c.cem_num_samples, num_elites=c.cem_num_elites,
+        iterations=c.cem_iterations, seed=c.seed + 7,
+        ladder=BucketLadder((c.warm_envs,)))
+
+    # Serving fleet with the capture seam installed.
+    serving_predictor = _HotReloadPredictor(model, host_variables)
+    stats = ServingStats(registry)
+    episode_recorder = EpisodeRecorder()
+    router = FleetRouter(
+        serving_predictor, devices=fleet_devices,
+        action_size=c.action_size, num_samples=c.cem_num_samples,
+        num_elites=c.cem_num_elites, iterations=c.cem_iterations,
+        seed=c.seed + 21, ladder_sizes=c.ladder_sizes, stats=stats,
+        flight_recorder=flight, episode_recorder=episode_recorder)
+    router.warmup(lambda s: sg.sample_scenes(
+        1, image_size=c.image_size, seed=int(s))[0][0])
+    router.start()
+
+    watcher = (ExportWatcher(export_root, flight_recorder=flight)
+               if c.promotes else None)
+    controller = RolloutController(
+        router, serving_predictor,
+        config=RolloutConfig(
+            mirror_fraction=c.mirror_fraction,
+            canary_fraction=c.canary_fraction,
+            min_shadow_samples=c.min_shadow_samples,
+            min_canary_samples=c.min_canary_samples,
+            max_q_regression=c.max_q_regression, seed=c.seed + 31),
+        watcher=watcher, poll_s=0.05, flight_recorder=flight)
+
+    staleness_ceiling = c.resolved_staleness_ceiling()
+    monitor = HealthMonitor(
+        flywheel_rules(staleness_ceiling,
+                       coverage_floor=c.coverage_floor,
+                       served_mix_floor=c.served_mix_floor),
+        registry=registry, recorder=flight, halt_on_breach=False)
+    ingest = FlywheelIngest(
+        queue, spec, lambda: self._step, monitor=monitor,
+        registry=registry, flight_recorder=flight,
+        coverage_window=c.coverage_window)
+
+    # ---- phase 1: synthetic warm start ------------------------------------
+    collector = CollectorWorker(
+        collector_policy, queue, c.image_size, num_envs=c.warm_envs,
+        max_attempts=c.max_attempts, seed=c.seed,
+        grasp_radius=c.grasp_radius,
+        exploration_epsilon=c.exploration_epsilon,
+        scripted_fraction=c.scripted_fraction, flight_recorder=flight)
+    collector.start()
+    fill_deadline = time.monotonic() + 120.0
+    while not feeder.ready():
+      feeder.drain()
+      if time.monotonic() > fill_deadline:
+        collector.stop()
+        raise RuntimeError(
+            f"replay min-fill {c.min_fill} not reached in 120s "
+            f"(size={buffer.size})")
+      time.sleep(0.01)
+
+    eval_batches, eval_stars = self._eval_set()
+    online = state.variables(use_ema=True)
+    initial_eval = self._eval(updater, online, eval_batches, eval_stars)
+    eval_history = [dict(step=0, phase="init", **initial_eval)]
+
+    train_metrics: Dict[str, float] = {}
+    for step in range(1, c.warm_steps + 1):
+      self._step = step
+      state, online, train_metrics = self._train_tick(
+          trainer, state, updater, feeder, buffer, model)
+      if step % c.refresh_every == 0:
+        host_variables = self._host_variables(state)
+        collector_predictor.update(host_variables)
+        updater.refresh(host_variables, step)
+    collector.stop()  # synthetic collection OFF — permanently
+    synthetic_episodes = collector.episodes
+
+    cutover_eval = self._eval(updater, online, eval_batches, eval_stars)
+    eval_history.append(dict(step=c.warm_steps, phase="cutover",
+                             **cutover_eval))
+
+    # ---- phase 2: cutover — deploy the warm model to the fleet ------------
+    warm_variables = self._host_variables(state)
+    ingest.mark_cutover()
+    serving_predictor.set_variables(warm_variables,
+                                    version=c.warm_steps)
+    updater.refresh(warm_variables, c.warm_steps)
+    controller.start()
+    client_slo = SLOClass(name="flywheel", priority=1,
+                          deadline_ms=c.deadline_ms)
+    client = FleetClient(
+        controller.submit, episode_recorder, ingest,
+        image_size=c.image_size, max_attempts=c.max_attempts,
+        grasp_radius=c.grasp_radius, seed=c.seed, slo=client_slo,
+        record_timeout_s=c.record_timeout_s, pace_s=c.client_pace_s,
+        flight_recorder=flight)
+    client.start()
+
+    # ---- phase 3: the closed loop -----------------------------------------
+    exports: List[int] = []
+    verdicts: List[dict] = []
+    timeline_cursor = len(controller.timeline())
+    client_error: Optional[str] = None
+    try:
+      end = c.warm_steps + c.fleet_steps
+      for step in range(c.warm_steps + 1, end + 1):
+        self._step = step
+        state, online, train_metrics = self._train_tick(
+            trainer, state, updater, feeder, buffer, model)
+        if step % c.refresh_every == 0:
+          updater.refresh(self._host_variables(state), step)
+        if c.promotes and (step - c.warm_steps) % c.export_every == 0:
+          host_variables = self._host_variables(state)
+          export_dir = self._export_step(export_root, step,
+                                         host_variables)
+          watcher.notify(export_dir, step)
+          exports.append(step)
+          verdict, timeline_cursor = self._await_verdict(
+              controller, timeline_cursor, c.promote_timeout_s)
+          verdicts.append({
+              "export_step": step,
+              "event": None if verdict is None else verdict["event"],
+          })
+          mid_eval = self._eval(updater, online, eval_batches,
+                                eval_stars)
+          eval_history.append(dict(
+              step=step,
+              phase=("post_" + verdict["event"]) if verdict else
+              "post_export_timeout", **mid_eval))
+      # Grace: hold the fleet open until at least one more episode
+      # ingests AT the terminal learner step, so the staleness metric
+      # is observed against the final step count. This is what makes
+      # the stale-params control's breach structural — the learner
+      # outruns the client, and without a terminal observation the
+      # breach would hinge on episode timing.
+      grace_deadline = time.monotonic() + 30.0
+      ingested_before = ingest.snapshot()["episodes_ingested"]
+      while (ingest.snapshot()["episodes_ingested"] == ingested_before
+             and time.monotonic() < grace_deadline):
+        time.sleep(0.05)
+    finally:
+      client.request_stop()
+      try:
+        client.stop()
+      except RuntimeError as e:
+        client_error = str(e.__cause__ or e)
+      controller.stop()
+      router.stop()
+
+    final_eval = self._eval(updater, online, eval_batches, eval_stars)
+    eval_history.append(dict(step=c.warm_steps + c.fleet_steps,
+                             phase="final", **final_eval))
+
+    # ---- evidence ---------------------------------------------------------
+    ledger = dict(self.compile_counts)
+    ledger.update({
+        f"bellman_{k}" if not k.startswith("bellman") else k: v
+        for k, v in updater.compile_counts.items()})
+    ledger.update({f"cem_collector_bucket_{k}": v
+                   for k, v in sorted(
+                       collector_policy.compile_counts.items())})
+    fleet_ledger = router.compile_ledger()
+    ledger_exactly_once = (
+        all(v == 1 for v in ledger.values())
+        and all(count == 1 for per_device in fleet_ledger.values()
+                for count in per_device.values()))
+
+    snapshot = stats.snapshot()
+    ingest_snap = ingest.snapshot()
+    client_snap = client.snapshot()
+    reconcile = {
+        "client_submits": client_snap["requests_submitted"],
+        "serving_logical_requests": snapshot["logical_requests"],
+        "captured_unique": episode_recorder.captured,
+        "ingested_transitions": ingest_snap["transitions_ingested"],
+        "ingested_unique_request_ids": ingest_snap["unique_request_ids"],
+        # The satellite-1 claim: episode accounting reconciles against
+        # serving stats with NO client-side bookkeeping required —
+        # logical requests count client submits 1:1 through every
+        # rollout phase, and every ingested transition carries a
+        # distinct captured request id.
+        "ok": bool(
+            client_snap["requests_submitted"]
+            == snapshot["logical_requests"]
+            and ingest_snap["unique_request_ids"]
+            == ingest_snap["transitions_ingested"]
+            and episode_recorder.captured
+            <= snapshot["logical_requests"]),
+    }
+
+    promotes_completed = sum(
+        1 for v in verdicts if v["event"] == "promote")
+    monitor_snap = monitor.snapshot()
+    improvement = (cutover_eval["eval_td_error"]
+                   - final_eval["eval_td_error"])
+
+    return {
+        "config": {
+            "warm_steps": c.warm_steps, "fleet_steps": c.fleet_steps,
+            "export_every": c.export_every,
+            "staleness_ceiling": staleness_ceiling,
+            "promotes_enabled": c.promotes,
+            "fleet_devices": len(fleet_devices),
+            "seed": c.seed,
+        },
+        "evals": {
+            "initial_td": initial_eval["eval_td_error"],
+            "cutover_td": cutover_eval["eval_td_error"],
+            "final_td": final_eval["eval_td_error"],
+            "fleet_phase_improvement": improvement,
+            "history": eval_history,
+        },
+        "train": train_metrics,
+        "promotes": {
+            "exports": exports,
+            "verdicts": verdicts,
+            "completed": promotes_completed,
+            "rollbacks": sum(1 for v in verdicts
+                             if v["event"] == "auto_rollback"),
+            "timeline": controller.timeline(),
+        },
+        "capture": episode_recorder.snapshot(),
+        "ingest": ingest_snap,
+        "client": dict(client_snap, error=client_error),
+        "synthetic": {"episodes": synthetic_episodes},
+        "provenance": buffer.provenance_counts(),
+        "reconcile": reconcile,
+        "health": {
+            "ok": monitor_snap["breach_count"] == 0,
+            "breach_count": monitor_snap["breach_count"],
+            "breaches_per_rule": monitor_snap["breaches_per_rule"],
+            "last_summary": monitor_snap["last_summary"],
+        },
+        "ledger": {
+            "learner": ledger,
+            "fleet": fleet_ledger,
+            "exactly_once": bool(ledger_exactly_once),
+        },
+        "queue": queue.stats(),
+        "serving": {
+            "logical_requests": snapshot["logical_requests"],
+            "requests": snapshot["requests"],
+            "shed_total": snapshot["shed_total"],
+        },
+        "workdir": workdir,
+    }

@@ -24,9 +24,10 @@ spinning up Bellman updaters against a warm log).
 
 from __future__ import annotations
 
+import inspect
 import threading
 from collections import deque
-from typing import Deque, Dict, List, Mapping, Optional
+from typing import Deque, Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
 
@@ -91,34 +92,45 @@ class TransitionQueue:
     enqueued: transitions accepted from collectors.
     dropped:  transitions shed by the drop-oldest policy.
     dequeued: transitions drained toward the buffer.
+
+  Provenance (ISSUE 18): every chunk carries a string label naming its
+  producer lineage ("synthetic" collectors vs. "served" fleet traffic);
+  labels travel with the rows through drop-oldest slicing and
+  ``drain_batch_with_provenance`` hands the buffer a per-row label
+  array, so the replay ring's mix accounting is exact even when a drain
+  spans chunks from both worlds.
   """
 
   def __init__(self, capacity: int):
     if capacity < 1:
       raise ValueError(f"capacity must be >= 1, got {capacity}")
     self.capacity = capacity
-    self._items: Deque[Dict[str, np.ndarray]] = deque()
+    self._items: Deque[Tuple[Dict[str, np.ndarray], str]] = deque()
     self._rows = 0
     self._lock = threading.Lock()
     self.enqueued = 0
     self.dropped = 0
     self.dequeued = 0
 
-  def put_episode(self, episode: Mapping[str, np.ndarray]) -> int:
+  def put_episode(self, episode: Mapping[str, np.ndarray],
+                  provenance: str = "synthetic") -> int:
     """Flattens an episode and enqueues its transitions; returns count."""
     transitions = episode_to_transitions(episode)
     if not transitions:
       return 0
     self.put_batch({key: np.stack([t[key] for t in transitions])
-                    for key in TRANSITION_KEYS})
+                    for key in TRANSITION_KEYS}, provenance=provenance)
     return len(transitions)
 
-  def put(self, transition: Dict[str, np.ndarray]) -> None:
+  def put(self, transition: Dict[str, np.ndarray],
+          provenance: str = "synthetic") -> None:
     """Enqueues one transition (drop-oldest when full)."""
     self.put_batch({key: np.asarray(value)[None]
-                    for key, value in transition.items()})
+                    for key, value in transition.items()},
+                   provenance=provenance)
 
-  def put_batch(self, batch: Mapping[str, np.ndarray]) -> int:
+  def put_batch(self, batch: Mapping[str, np.ndarray],
+                provenance: str = "synthetic") -> int:
     """Enqueues n stacked transitions as ONE chunk; returns n.
 
     The vectorized actor's fixed-chunk producer call: one fleet step's
@@ -138,6 +150,7 @@ class TransitionQueue:
     would silently rewrite queued transitions.
     """
     chunk = {key: np.asarray(value) for key, value in batch.items()}
+    provenance = str(provenance)
     sizes = {value.shape[0] for value in chunk.values()}
     if len(sizes) != 1:
       raise ValueError(f"inconsistent chunk leading dims: {sizes}")
@@ -149,9 +162,9 @@ class TransitionQueue:
       if n >= self.capacity:
         shed = self._rows + (n - self.capacity)
         self._items.clear()
-        self._items.append(
+        self._items.append((
             {key: value[n - self.capacity:]
-             for key, value in chunk.items()})
+             for key, value in chunk.items()}, provenance))
         self._rows = self.capacity
         self.dropped += shed
         return n
@@ -159,7 +172,7 @@ class TransitionQueue:
       if overflow > 0:
         _, shed = self._pop_rows_locked(overflow)
         self.dropped += shed
-      self._items.append(chunk)
+      self._items.append((chunk, provenance))
       self._rows += n
     return n
 
@@ -167,20 +180,22 @@ class TransitionQueue:
     """Pops up to `limit` rows of chunks off the head (sliced when the
     limit lands mid-chunk); caller holds the lock and advances the
     matching counter — `dequeued` for drains, `dropped` for shedding —
-    by the returned row count. Returns (chunks, rows_popped)."""
-    taken: List[Dict[str, np.ndarray]] = []
+    by the returned row count. Returns ((chunk, provenance) pairs,
+    rows_popped)."""
+    taken: List[Tuple[Dict[str, np.ndarray], str]] = []
     popped = 0
     while popped < limit and self._items:
-      head = self._items[0]
+      head, provenance = self._items[0]
       rows = _chunk_rows(head)
       need = limit - popped
       if rows <= need:
         self._items.popleft()
-        taken.append(head)
+        taken.append((head, provenance))
       else:
-        taken.append({key: value[:need] for key, value in head.items()})
-        self._items[0] = {key: value[need:]
-                          for key, value in head.items()}
+        taken.append(({key: value[:need] for key, value in head.items()},
+                      provenance))
+        self._items[0] = ({key: value[need:]
+                           for key, value in head.items()}, provenance)
         rows = need
       self._rows -= rows
       popped += rows
@@ -191,11 +206,11 @@ class TransitionQueue:
     """Pops up to max_items (default: all) as per-transition dicts,
     FIFO order (chunks are unstacked into row views outside the lock)."""
     with self._lock:
-      chunks, popped = self._pop_rows_locked(
+      pairs, popped = self._pop_rows_locked(
           self._rows if max_items is None else max_items)
       self.dequeued += popped
     return [{key: value[i] for key, value in chunk.items()}
-            for chunk in chunks for i in range(_chunk_rows(chunk))]
+            for chunk, _ in pairs for i in range(_chunk_rows(chunk))]
 
   def drain_batch(self, max_items: Optional[int] = None
                   ) -> Optional[Dict[str, np.ndarray]]:
@@ -214,16 +229,32 @@ class TransitionQueue:
     Returns None when the queue is empty (the per-step drain's common
     case, kept allocation-free).
     """
+    batch, _ = self.drain_batch_with_provenance(max_items)
+    return batch
+
+  def drain_batch_with_provenance(
+      self, max_items: Optional[int] = None
+  ) -> Tuple[Optional[Dict[str, np.ndarray]], Optional[np.ndarray]]:
+    """``drain_batch`` plus a per-row provenance label array (ISSUE 18).
+
+    Returns (batch, labels): labels[i] names the producer lineage of
+    batch row i ("synthetic" | "served" | ...), built from the chunk
+    tags outside the lock. (None, None) when the queue is empty.
+    """
     with self._lock:
-      chunks, popped = self._pop_rows_locked(
+      pairs, popped = self._pop_rows_locked(
           self._rows if max_items is None else max_items)
       self.dequeued += popped
-    if not chunks:
-      return None
-    if len(chunks) == 1:
-      return chunks[0]
-    return {key: np.concatenate([chunk[key] for chunk in chunks])
-            for key in chunks[0]}
+    if not pairs:
+      return None, None
+    if len(pairs) == 1:
+      chunk, provenance = pairs[0]
+      return chunk, np.full(_chunk_rows(chunk), provenance)
+    labels = np.concatenate([
+        np.full(_chunk_rows(chunk), provenance)
+        for chunk, provenance in pairs])
+    return {key: np.concatenate([chunk[key] for chunk, _ in pairs])
+            for key in pairs[0][0]}, labels
 
   def restore_counters(self, enqueued: int, dropped: int,
                        dequeued: int) -> None:
@@ -272,6 +303,12 @@ class ReplayFeeder:
     self.queue = queue
     self.buffer = buffer
     self.min_fill = min_fill
+    # Provenance pass-through (ISSUE 18): the host ring buffers carry
+    # per-provenance ingest counters; the device-resident buffer does
+    # not (its extend signature has no provenance), so the hand-off is
+    # feature-detected once here instead of guessed per drain.
+    self._extend_takes_provenance = "provenance" in inspect.signature(
+        buffer.extend).parameters
 
   def drain(self) -> int:
     """Moves every pending transition into the buffer; returns count.
@@ -281,9 +318,11 @@ class ReplayFeeder:
     and the same call feeds the device-resident buffer, whose extend
     stages fixed-shape chunks to the chip.
     """
-    batch = self.queue.drain_batch()
+    batch, labels = self.queue.drain_batch_with_provenance()
     if batch is None:
       return 0
+    if self._extend_takes_provenance:
+      return self.buffer.extend(batch, provenance=labels)
     return self.buffer.extend(batch)
 
   def ready(self) -> bool:

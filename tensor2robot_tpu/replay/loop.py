@@ -133,6 +133,17 @@ class _HotReloadPredictor(AbstractPredictor):
     self._variables = variables
     self._version += 1
 
+  def set_variables(self, variables, version=None, cast: bool = False
+                    ) -> None:
+    """The rollout promotion entry point (serving/rollout.py): the same
+    atomic swap as ``update()``, but carrying the candidate's export
+    version so ``model_version`` names the promoted learner step — the
+    number the flywheel's staleness-lag metric subtracts from the
+    current learner step (ISSUE 18)."""
+    del cast  # host trees only; nothing to cast
+    self._variables = variables
+    self._version = self._version + 1 if version is None else int(version)
+
   def restore(self, timeout_s: float = 0.0,
               raise_on_timeout: bool = False) -> bool:
     return True

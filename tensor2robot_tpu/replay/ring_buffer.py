@@ -106,6 +106,12 @@ class ReplayBuffer:
     self._next = 0
     self._size = 0
     self._append_count = 0
+    # Provenance ledger (ISSUE 18): monotonic per-lineage ingest counts
+    # ("synthetic" collectors vs. "served" fleet traffic). Counts
+    # INGESTED transitions, not retained ones — the flywheel's mix
+    # accounting is about what the learner has consumed, and a ring
+    # overwrite doesn't un-consume the overwritten row.
+    self._provenance: Dict[str, int] = {}
     # Append index at which each slot was last written (staleness).
     self._written_at = np.zeros(capacity, np.int64)
     self._prioritized = prioritized
@@ -116,7 +122,8 @@ class ReplayBuffer:
 
   # --- writes --------------------------------------------------------------
 
-  def append(self, transition: Mapping[str, np.ndarray]) -> int:
+  def append(self, transition: Mapping[str, np.ndarray],
+             provenance: str = "synthetic") -> int:
     """Validates + writes one transition; returns the slot. O(1)."""
     arrays = self._validate(transition, batched=False)
     with self._lock:
@@ -125,6 +132,8 @@ class ReplayBuffer:
         self._storage[key][slot] = array
       self._written_at[slot] = self._append_count
       self._append_count += 1
+      self._provenance[provenance] = (
+          self._provenance.get(provenance, 0) + 1)
       self._next = (self._next + 1) % self.capacity
       self._size = min(self._size + 1, self.capacity)
       if self._tree is not None:
@@ -133,7 +142,8 @@ class ReplayBuffer:
         self._tree.set(slot, self._max_priority)
     return slot
 
-  def extend(self, transitions: Mapping[str, np.ndarray]) -> int:
+  def extend(self, transitions: Mapping[str, np.ndarray],
+             provenance="synthetic") -> int:
     """Appends a batch (leading axis on every leaf); returns count.
 
     ONE vectorized slot write per key (the ingest extend path used to
@@ -142,12 +152,20 @@ class ReplayBuffer:
     bursts larger than capacity: modular positions repeat and numpy
     fancy-store keeps the LAST write per slot, which is precisely the
     survivor a one-by-one wraparound leaves.
+
+    ``provenance`` is either one label for the whole batch or a per-row
+    label sequence (the TransitionQueue's drain emits the latter when a
+    drain spans chunks from different producers — ISSUE 18); either way
+    the per-lineage ledger advances by exactly the ingested row counts.
     """
     arrays = self._validate(transitions, batched=True)
     n = next(iter(arrays.values())).shape[0]
     if n == 0:
       return 0
+    counts = _provenance_counts(provenance, n)
     with self._lock:
+      for label, rows in counts.items():
+        self._provenance[label] = self._provenance.get(label, 0) + rows
       positions = (self._next + np.arange(n)) % self.capacity
       for key, array in arrays.items():
         self._storage[key][positions] = array
@@ -249,6 +267,10 @@ class ReplayBuffer:
           "append_count": self._append_count,
           "max_priority": self._max_priority,
           "rng_state": self._rng.bit_generator.state,
+          # Mix accounting rides the checkpoint (ISSUE 18): a resumed
+          # flywheel's served/synthetic ledger continues bit-exactly.
+          "provenance": {k: int(v)
+                         for k, v in sorted(self._provenance.items())},
       }
     return arrays, meta
 
@@ -281,6 +303,10 @@ class ReplayBuffer:
       self._size = int(meta["size"])
       self._append_count = int(meta["append_count"])
       self._max_priority = float(meta["max_priority"])
+      # Pre-ISSUE-18 checkpoints carry no provenance block: restore an
+      # empty ledger rather than refusing the resume.
+      self._provenance = {str(k): int(v)
+                          for k, v in meta.get("provenance", {}).items()}
       self._rng.bit_generator.state = meta["rng_state"]
       if self._tree is not None:
         leaves = np.asarray(arrays["priorities"], np.float64)
@@ -295,6 +321,11 @@ class ReplayBuffer:
   @property
   def append_count(self) -> int:
     return self._append_count
+
+  def provenance_counts(self) -> Dict[str, int]:
+    """{lineage: transitions ingested} — monotonic (ISSUE 18)."""
+    with self._lock:
+      return dict(self._provenance)
 
   @property
   def fill_fraction(self) -> float:
@@ -323,12 +354,15 @@ class ReplayBuffer:
 
   def metrics(self) -> Dict[str, float]:
     """The buffer's scalar health block (metric_writer-ready)."""
-    return {
+    out = {
         "replay/fill_fraction": self.fill_fraction,
         "replay/size": float(self._size),
         "replay/append_count": float(self._append_count),
         "replay/priority_entropy": self.priority_entropy(),
     }
+    for label, count in self.provenance_counts().items():
+      out[f"replay/provenance/{label}"] = float(count)
+    return out
 
   # --- validation ----------------------------------------------------------
 
@@ -385,24 +419,34 @@ class ShardedReplayBuffer:
     self._lock = threading.Lock()
     self._stripe = 0
 
-  def append(self, transition: Mapping[str, np.ndarray]) -> int:
+  def append(self, transition: Mapping[str, np.ndarray],
+             provenance: str = "synthetic") -> int:
     with self._lock:
       shard = self._stripe
       self._stripe = (self._stripe + 1) % self.num_shards
-    slot = self._shards[shard].append(transition)
+    slot = self._shards[shard].append(transition, provenance=provenance)
     return shard * self._shard_capacity + slot
 
-  def extend(self, transitions: Mapping[str, np.ndarray]) -> int:
+  def extend(self, transitions: Mapping[str, np.ndarray],
+             provenance="synthetic") -> int:
     # Validate the WHOLE batch first (mismatched leading dims fail here
     # with a named key), so a bad payload can never partially stripe
     # into the shards before raising. Rows then stripe round-robin in
     # ONE grouped vectorized write per shard — identical final state to
     # n sequential appends (within a shard, row order is preserved, so
     # slots and shard-local append indices match the one-by-one path).
+    # Per-row provenance labels (ISSUE 18) stripe under the same masks,
+    # so each shard's lineage ledger counts exactly its own rows and the
+    # checkpointed per-shard ledgers sum to the global mix.
     arrays = _validate_against_spec(self._spec, transitions, batched=True)
     n = next(iter(arrays.values())).shape[0]
     if n == 0:
       return 0
+    labels = (None if isinstance(provenance, str)
+              else np.asarray(provenance))
+    if labels is not None and labels.shape[0] != n:
+      raise ValueError(
+          f"provenance labels {labels.shape[0]} != batch rows {n}")
     with self._lock:
       start = self._stripe
       self._stripe = (self._stripe + n) % self.num_shards
@@ -410,7 +454,9 @@ class ShardedReplayBuffer:
     for i, shard in enumerate(self._shards):
       mask = shard_of == i
       if mask.any():
-        shard.extend({key: array[mask] for key, array in arrays.items()})
+        shard.extend(
+            {key: array[mask] for key, array in arrays.items()},
+            provenance=provenance if labels is None else labels[mask])
     return n
 
   def sample(self) -> Tuple[ts.TensorSpecStruct, SampleInfo]:
@@ -484,6 +530,15 @@ class ShardedReplayBuffer:
   def append_count(self) -> int:
     return sum(shard.append_count for shard in self._shards)
 
+  def provenance_counts(self) -> Dict[str, int]:
+    """Global {lineage: count}: the sum of the shards' ledgers (each
+    shard checkpoints its own, so resume is bit-exact per stripe)."""
+    totals: Dict[str, int] = {}
+    for shard in self._shards:
+      for label, count in shard.provenance_counts().items():
+        totals[label] = totals.get(label, 0) + count
+    return totals
+
   @property
   def fill_fraction(self) -> float:
     return self.size / self.capacity
@@ -494,12 +549,32 @@ class ShardedReplayBuffer:
         [shard.priority_entropy() for shard in self._shards]))
 
   def metrics(self) -> Dict[str, float]:
-    return {
+    out = {
         "replay/fill_fraction": self.fill_fraction,
         "replay/size": float(self.size),
         "replay/append_count": float(self.append_count),
         "replay/priority_entropy": self.priority_entropy(),
     }
+    for label, count in self.provenance_counts().items():
+      out[f"replay/provenance/{label}"] = float(count)
+    return out
+
+
+def _provenance_counts(provenance, n: int) -> Dict[str, int]:
+  """One whole-batch label or a per-row label sequence → {label: rows}.
+
+  A per-row sequence must cover the batch exactly — a silent broadcast
+  or truncation would corrupt the mix ledger it exists to keep.
+  """
+  if isinstance(provenance, str):
+    return {provenance: n}
+  labels = np.asarray(provenance)
+  if labels.shape[0] != n:
+    raise ValueError(
+        f"provenance labels {labels.shape[0]} != batch rows {n}")
+  unique, counts = np.unique(labels, return_counts=True)
+  return {str(label): int(count)
+          for label, count in zip(unique, counts)}
 
 
 def _validate_against_spec(spec_struct, transition: Mapping[str, np.ndarray],

@@ -324,7 +324,8 @@ class RolloutController:
 
   # -- client API ----------------------------------------------------------
 
-  def submit(self, image, slo: Optional[SLOClass] = None) -> Future:
+  def submit(self, image, slo: Optional[SLOClass] = None,
+             request_id: Optional[str] = None) -> Future:
     """Routes one frame; mirrors or canaries it per the current phase.
 
     Both phases compare PAIRED on the same (image, seed): shadow pairs
@@ -333,6 +334,11 @@ class RolloutController:
     mirror. Pairing is what makes the q-delta bar sharp — an
     equal-weights candidate scores delta exactly 0 instead of
     image-sampling noise.
+
+    Exactly ONE ``router.submit`` happens per call in every phase
+    (canary serves through the shadow batcher and mirrors through the
+    router), so the router's logical-request counter counts client
+    requests 1:1 regardless of rollout phase (ISSUE 18).
     """
     state = self._state  # racy read is fine: phases change rarely and
     # a request misrouted by one transition is just one more/fewer
@@ -341,8 +347,10 @@ class RolloutController:
     # ONE correlation id for the request AND any mirror/canary twin it
     # spawns (ISSUE 12): the mirror is the same logical request served
     # twice, so its spans must join the parent's timeline, not start
-    # their own.
-    request_id = context_lib.new_request_id()
+    # their own. A caller-supplied id (the flywheel's episode driver,
+    # ISSUE 18) threads through unchanged so the captured transition is
+    # traceable to the caller's own request record.
+    request_id = request_id or context_lib.new_request_id()
     if state == "canary" and self._draw() < self._config.canary_fraction:
       future = self._shadow_submit(image, seed, slo=slo,
                                    request_id=request_id)

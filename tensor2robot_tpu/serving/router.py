@@ -55,11 +55,13 @@ class PolicyReplica:
                max_queue: Optional[int], dispatch_margin_ms: float,
                flight_recorder=None,
                fault_plan: Optional[faults_lib.FaultPlan] = None,
-               restart_budget: int = 3):
+               restart_budget: int = 3,
+               episode_recorder=None):
     self.policy = policy
     self.device = policy.device
     self.stats = stats
     self._faults = fault_plan
+    self._episode_recorder = episode_recorder
     # corrupt_served_variables state (ISSUE 15): once the fault fires,
     # the replica serves a finite-but-wrong scaled copy of the live
     # params — STICKY, like the botched hot-swap it models — until the
@@ -134,6 +136,20 @@ class PolicyReplica:
         # flush (the listener contract).
         try:
           self.stats.record_q_values(str(self.device), scores)
+        except Exception:
+          pass
+      if self._episode_recorder is not None:
+        # Capture seam (ISSUE 18): the flywheel's EpisodeRecorder logs
+        # what this batch actually SERVED — the post-fault actions, the
+        # CEM seeds, the batch's bound request_ids (the batcher binds
+        # them in item order before calling us), and the params version
+        # the dispatch ran under. Exception-isolated like the sketch
+        # feed: capture never fails a flush.
+        try:
+          self._episode_recorder.record_served(
+              items, actions, device=str(self.device),
+              params_version=getattr(
+                  self.policy._predictor, "model_version", None))
         except Exception:
           pass
       return list(actions)
@@ -216,7 +232,8 @@ class FleetRouter:
                health: Optional[HealthConfig] = None,
                fault_plan: Optional[faults_lib.FaultPlan] = None,
                tp_group: int = 1,
-               param_specs=None):
+               param_specs=None,
+               episode_recorder=None):
     import jax
 
     from tensor2robot_tpu.research.qtopt import cem
@@ -287,6 +304,10 @@ class FleetRouter:
     # divergent — transitions (not steady states) fire the
     # replica_divergent flightrec trigger and the timeline event.
     self._divergent_replicas = set()
+    # Flywheel capture (ISSUE 18): one EpisodeRecorder shared by every
+    # replica — the serving seam where fleet traffic becomes training
+    # data. None (the default) keeps serving capture-free.
+    self._episode_recorder = episode_recorder
     self._started_at = time.perf_counter()
     self.replicas = []
     self._breakers = []
@@ -303,7 +324,8 @@ class FleetRouter:
           policy, replica_max_batch, deadline_ms, self.stats, max_queue,
           dispatch_margin_ms, flight_recorder=self._recorder,
           fault_plan=fault_plan,
-          restart_budget=self.health.restart_budget))
+          restart_budget=self.health.restart_budget,
+          episode_recorder=self._episode_recorder))
       self._breakers.append(slo_lib.CircuitBreaker(
           self.health.failure_threshold, self.health.quarantine_s))
 
@@ -458,13 +480,15 @@ class FleetRouter:
     never a raw replica exception. (Per-class ServingStats request
     counters count dispatch ATTEMPTS — a retried request is two — and
     a request shed as "fault" after a synchronous submit failure may
-    carry no matching attempt; logical-request accounting lives in the
-    benches' client-side completion counters.)
+    carry no matching attempt; ``stats.record_logical_request`` counts
+    exactly one per submit — ISSUE 18 — so flywheel episode accounting
+    reconciles against serving stats without client-side bookkeeping.)
     """
     if slo is not None and deadline_at is None:
       deadline_at = time.perf_counter() + slo.deadline_ms / 1e3
     seed = self.assign_seed() if seed is None else int(seed)
     request_id = request_id or context_lib.new_request_id()
+    self.stats.record_logical_request()
     outer: Future = Future()
     self._dispatch(outer, np.asarray(image), seed, slo, deadline_at,
                    request_id, excluded=frozenset(), retries=0)

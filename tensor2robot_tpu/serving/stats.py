@@ -146,6 +146,7 @@ class ServingStats:
     self._registry = registry or registry_lib.get_registry()
     self.latency = LatencyHistogram()
     self._requests = 0
+    self._logical_requests = 0
     self._flushes = 0
     self._occupied_slots = 0   # sum of real requests over flushes
     self._padded_slots = 0     # sum of compiled bucket sizes over flushes
@@ -175,6 +176,25 @@ class ServingStats:
     # have a request denominator.
     self._registry.counter(
         f"serving/class/{class_name or 'default'}/requests").inc()
+
+  def record_logical_request(self) -> None:
+    """One LOGICAL request at the router front door (ISSUE 18).
+
+    ``record_request`` counts dispatch ATTEMPTS — a faulted dispatch
+    that retries on a second replica records twice — so benches have
+    historically kept client-side truth to reconcile against. The
+    flywheel needs that reconciliation without external bookkeeping:
+    this counter increments exactly once per ``FleetRouter.submit``
+    call, before any dispatch, so
+
+        logical_requests == client submits
+        logical_requests - shed_total == answered requests
+
+    holds regardless of retry amplification.
+    """
+    with self._lock:
+      self._logical_requests += 1
+    self._registry.counter("serving/logical_requests").inc()
 
   def record_shed(self, class_name: Optional[str], reason: str) -> None:
     """One shed request: reason is "expired" (deadline already past at
@@ -249,6 +269,7 @@ class ServingStats:
       flushes = self._flushes
       out = {
           "requests": self._requests,
+          "logical_requests": self._logical_requests,
           "flushes": flushes,
           "deadline_flushes": self._deadline_flushes,
           "batch_occupancy": round(
