@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 18
+ROUND = 19
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -1136,6 +1136,49 @@ def _bench_flywheel_compact():
       control_fleet_steps=60, enforce_bars=False)
 
 
+def _bench_multihost_compact():
+  """Pod-scale bring-up block for the bench detail (ISSUE 19).
+
+  The committed chipless artifact (MULTIHOST_r19.json) carries the full
+  protocol — 2 REAL processes x 4 virtual CPU devices through the JAX
+  coordination service running ONE anakin_step with exactly-once
+  per-process compile ledgers, the seam-vs-r17-oracle single-process
+  bit-parity pair, kill-one-process fused checkpoint resume with the
+  post-resume stream parity bar, and the router-of-routers front door
+  (1:1 ingress reconciliation, drift-rollup cross-host quarantine by
+  name) — where throughput/scaling keys are null by the virtual-mesh
+  honesty rule. This block is the driver-refreshable counterpart at
+  reduced scale: the front-door phase runs on the window's devices
+  (the per-class p99 headroom becomes a measured serving number), and
+  the 2-process bring-up + kill-one-process resume re-run live in CPU
+  worker subprocesses (the learner phases emulate controllers, so they
+  measure structure on any host — a single-chip window cannot host two
+  REAL controllers, which is why their throughput stays null).
+  """
+  import tempfile
+  from tensor2robot_tpu.parallel.multihost_bench import (
+      measure_frontdoor, measure_fused_resume, measure_mesh_bringup)
+  with tempfile.TemporaryDirectory() as workdir:
+    bringup = measure_mesh_bringup(
+        os.path.join(workdir, "bringup"), seed=0, num_steps=10,
+        checkpoint_dir=os.path.join(workdir, "ckpt"), enforce_bars=False)
+    control = bringup.pop("control_workers")
+    resume = measure_fused_resume(
+        os.path.join(workdir, "resume"), seed=0, num_steps=10,
+        control_workers=control, enforce_bars=False)
+  frontdoor = measure_frontdoor(seed=0, requests=120, enforce_bars=False)
+  return {
+      "mesh_bringup": bringup,
+      "fused_resume": resume,
+      "frontdoor": frontdoor,
+      "multihost_processes": (bringup.get("processes")
+                              if all(bringup.get("bars", {}).values())
+                              else None),
+      "fused_resume_parity_ok": resume.get("fused_resume_parity_ok"),
+      "frontdoor_p99_headroom": frontdoor.get("frontdoor_p99_headroom"),
+  }
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1322,6 +1365,11 @@ def main() -> None:
   except Exception as e:
     flywheel = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    multihost = _bench_multihost_compact()
+  except Exception as e:
+    multihost = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1387,6 +1435,7 @@ def main() -> None:
       "health": health,
       "tpquant": tpquant,
       "flywheel": flywheel,
+      "multihost": multihost,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1485,6 +1534,16 @@ def main() -> None:
           "flywheel_policy_improvement"),
       "flywheel_ingest_health_ok": flywheel.get(
           "flywheel_ingest_health_ok"),
+      # Pod-scale sentinels (ISSUE 19): how many REAL controller
+      # processes the block's live reduced bring-up spanned (null
+      # unless every bring-up bar held), whether kill-one-process
+      # fused resume reproduced the uninterrupted control bit for
+      # bit, and the front door's min per-class p99 headroom (a
+      # timing claim: null when quantitative-gated or errored).
+      # Null-safe under outage/error like every compact key.
+      "multihost_processes": multihost.get("multihost_processes"),
+      "fused_resume_parity_ok": multihost.get("fused_resume_parity_ok"),
+      "frontdoor_p99_headroom": multihost.get("frontdoor_p99_headroom"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,
   }))

@@ -327,6 +327,29 @@ else
     python -m tensor2robot_tpu.bin.bench_flywheel --smoke \
       --out "$STAGE_TMP"'
 fi
+# Eleventh chipless backstop (ISSUE 19): the pod bring-up protocol —
+# one anakin_step lowered across 2 REAL processes x 4 virtual CPU
+# devices over the JAX coordination service (exactly-once per-process
+# compile ledgers, tp rules + ZeRO-1 composed on the cross-process
+# mesh), the seam-vs-r17-oracle single-process bit-parity pair, the
+# kill-one-process fused checkpoint resume parity proof, and the
+# router-of-routers front door with cross-host quarantine by name.
+# Throughput/scaling keys are null by the virtual-mesh honesty rule.
+# Pytest deferral matters doubly here: the phases spawn real worker
+# processes on a small host, and the front-door p99 bars are timing
+# asserts.
+if [ -s "MULTIHOST_${RTAG}.json" ]; then
+  log "skip MULTIHOST_${RTAG}.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring multihost backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "MULTIHOST_${RTAG}.json" 3000 sh -c '
+    python -m tensor2robot_tpu.bin.bench_multihost --smoke \
+      --out "$STAGE_TMP"'
+fi
 while [ "$(date +%s)" -lt "$deadline" ]; do
   # Never perturb a live test run: the probe's jax import is real CPU
   # on a small host, and the serving smoke's amortization bar is a

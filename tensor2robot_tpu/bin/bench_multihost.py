@@ -1,0 +1,25 @@
+"""Pod-scale bring-up CLI: the bin/ face of parallel/multihost_bench.
+
+    # The committed MULTIHOST_r19 protocol (chipless: 2 REAL processes
+    # x 4 virtual CPU devices each over the JAX coordination service;
+    # acceptance bars are ENFORCED at generation time):
+    python -m tensor2robot_tpu.bin.bench_multihost --smoke --out MULTIHOST_r19.json
+
+    # Reduced tier-1 lane (front-door phase only, bars deferred):
+    python -m tensor2robot_tpu.bin.bench_multihost --ci
+
+Everything — the 2-process anakin_step bring-up with exactly-once
+per-process compile ledgers, the seam-vs-r17-oracle single-process
+bit-parity pair, the kill-one-process fused checkpoint resume with the
+post-resume stream parity bar, and the router-of-routers front door
+(ingress-stamped deadlines across the hop, 1:1 request reconciliation,
+drift-rollup cross-host quarantine by name) — lives in
+parallel/multihost_bench.py; this wrapper exists so the pod protocol is
+discoverable next to bench_fleet in the bin/ surface every other
+measured artifact is produced from.
+"""
+
+from tensor2robot_tpu.parallel.multihost_bench import main
+
+if __name__ == "__main__":
+  main()

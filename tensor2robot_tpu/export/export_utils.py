@@ -48,6 +48,20 @@ def versioned_export_dir(export_root: str) -> Tuple[str, str]:
 
 
 def publish(tmp_dir: str, final_dir: str) -> str:
+  """Atomically publishes tmp_dir as final_dir (the rename robots
+  watch for). A pre-existing final_dir is refused BY NAME (ISSUE 19):
+  step-named export dirs (export_and_gc on a reused workdir) collide
+  when a re-run reaches the same step, and the bare os.rename then
+  dies with a bare OSError errno 39 (directory not empty) that names
+  neither path — worse, on some platforms it could clobber the export
+  a robot is mid-download on. Versioned dirs never hit this
+  (versioned_export_dir allocates monotonically past survivors)."""
+  if os.path.exists(final_dir):
+    raise FileExistsError(
+        f"export target already exists: {final_dir} (publishing "
+        f"{tmp_dir}). A reused workdir re-reached an already-exported "
+        "step — remove the stale export dir or point the run at a "
+        "fresh workdir; refusing to clobber a published export.")
   os.rename(tmp_dir, final_dir)
   return final_dir
 

@@ -24,6 +24,10 @@ import os
 from typing import Any, Optional, Sequence
 
 import jax
+import jax.export  # the jax.export submodule is lazy: attribute access
+# alone raises AttributeError in a process where nothing else has
+# imported it (bare multi-host workers; the in-process test suite gets
+# it transitively and never sees this).
 import numpy as np
 
 from tensor2robot_tpu.export import export_utils, variables_io

@@ -144,9 +144,10 @@ def _measure_ingest_gate(image_size: int, action_size: int,
   snapshot = ingest.snapshot()
   dumps = _find_dumps(logdir, "flywheel_ingest_rejected")
   # Refusals raise AND count AND dump — never a silent drop: the queue
-  # holds exactly the accepted episode's transitions. (Dump files are
-  # ms-stamped, so back-to-back refusals can coalesce onto one file —
-  # the per-refusal ledger is the counter, the dump is the evidence.)
+  # holds exactly the accepted episode's transitions. Dump filenames
+  # carry a monotonic per-process sequence since ISSUE 19, so N
+  # refusals yield EXACTLY N files (the old ms-stamped names coalesced
+  # back-to-back refusals and this bar was stuck at ">= 1").
   return {
       "accepted_transitions": accepted,
       "cases": cases,
@@ -156,7 +157,7 @@ def _measure_ingest_gate(image_size: int, action_size: int,
       "ok": bool(accepted == steps
                  and all(case["ok"] for case in cases)
                  and snapshot["rejected"] == len(cases)
-                 and len(dumps) >= 1
+                 and len(dumps) == len(cases)
                  and queue.stats()["enqueued"] == steps),
   }
 

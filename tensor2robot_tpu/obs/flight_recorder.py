@@ -46,6 +46,14 @@ _log = logging.getLogger(__name__)
 
 SCHEMA = "t2r-flightrec-1"
 
+# Per-process monotonic dump sequence, shared across ALL recorder
+# instances (two recorders pointed at one dir must not coalesce
+# either). See dump() — ISSUE 19.
+import itertools
+
+_DUMP_SEQ = itertools.count()
+_SEQ_LOCK = threading.Lock()
+
 
 class FlightRecorder:
   """Bounded event ring with rate-limited atomic post-mortem dumps."""
@@ -148,8 +156,16 @@ class FlightRecorder:
       events = list(self._events)
       events_total = self.events_total
     slug = re.sub(r"[^A-Za-z0-9_-]+", "_", reason)[:48] or "unknown"
+    # Monotonic per-process sequence (ISSUE 19): ms-stamped names alone
+    # coalesce back-to-back dumps — two triggers inside one millisecond
+    # (or two recorders sharing a dir) silently overwrote each other,
+    # which is why the flywheel/health bars were stuck at "dumps >= 1".
+    # N triggers now yield N files.
+    with _SEQ_LOCK:
+      seq = next(_DUMP_SEQ)
     path = os.path.join(
-        directory, f"flightrec-{int(time.time() * 1e3)}-{slug}.json")
+        directory,
+        f"flightrec-{int(time.time() * 1e3)}-{seq:04d}-{slug}.json")
     payload = {
         "schema": SCHEMA,
         "host": socket.gethostname(),

@@ -347,7 +347,10 @@ def _measure_corrupt_served(devices, requests: int, seed: int) -> Dict:
       "correlated_fault_dumps": correlated,
       "aggregate_verdict": aggregate_health["verdict"],
       "aggregate_divergent": aggregate_health["q_drift"]["divergent"],
-      "ok": bool(detected and window["divergent_dumps"] >= 1
+      # EXACT dump count (ISSUE 19 de-coalesced filenames): one
+      # divergent TRANSITION fires one replica_divergent dump — the
+      # snapshot's single check_q_drift pass — no more, no less.
+      "ok": bool(detected and window["divergent_dumps"] == 1
                  and correlated >= 1
                  and "replica_divergent" in window["timeline_events"]
                  and aggregate_divergent_ok),

@@ -187,7 +187,8 @@ class MetricRegistry:
           out[name] = value
     return out
 
-  def export_snapshot(self, path: str) -> str:
+  def export_snapshot(self, path: str,
+                      host: Optional[str] = None) -> str:
     """Writes this process's full registry state for the fleet merge.
 
     Atomic (tmp → mv), host/pid-stamped, schema-versioned. Counters
@@ -195,6 +196,12 @@ class MetricRegistry:
     reservoir (plus the true count), because cross-process percentile
     merging needs samples, not percentiles — obs/aggregate.py unions
     the reservoirs and runs the one nearest-rank pass.
+
+    ``host`` overrides the hostname stamp — the multi-host emulation
+    seam (ISSUE 19): per-emulated-host registries written from one
+    machine keep distinct ``host:pid`` merge keys, so the aggregator's
+    per-source Q-drift attribution names the emulated host exactly as
+    a real pod's would.
     """
     with self._lock:
       metrics = dict(self._metrics)
@@ -212,7 +219,7 @@ class MetricRegistry:
                             "samples": metric.samples()}
     payload = {
         "schema": SNAPSHOT_SCHEMA,
-        "host": socket.gethostname(),
+        "host": host or socket.gethostname(),
         "pid": os.getpid(),
         "counters": counters,
         "gauges": gauges,

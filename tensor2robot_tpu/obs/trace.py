@@ -150,7 +150,8 @@ class Tracer:
       self._spans.clear()
       self._total = 0
 
-  def export_chrome_trace(self, path: str) -> str:
+  def export_chrome_trace(self, path: str,
+                          label: Optional[str] = None) -> str:
     """Writes the retained spans as Chrome-trace JSON (atomic tmp→mv).
 
     Loads directly in Perfetto / chrome://tracing; complete events
@@ -160,6 +161,11 @@ class Tracer:
     obs/context.py) additionally becomes one flow — "s"/"t"/"f"
     arrow events with a shared id — so a request's enqueue → flush →
     dispatch hops across threads read as one clickable timeline.
+
+    ``label`` overrides the ``host:pid`` process_name metadata — the
+    front door (serving/frontdoor.py) exports its OWN tracer under its
+    own label so the fleet merge gives the ingress hop its own lane
+    and cross-lane request flows (ISSUE 19).
     """
     retained = self.spans()
     pid = os.getpid()
@@ -171,7 +177,7 @@ class Tracer:
     epoch_wall_s = time.time() - (time.perf_counter() - self._epoch)
     events = [{
         "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-        "args": {"name": f"{socket.gethostname()}:{pid}",
+        "args": {"name": label or f"{socket.gethostname()}:{pid}",
                  "epoch_wall_s": round(epoch_wall_s, 6)},
     }]
     by_request: Dict[str, list] = {}

@@ -22,6 +22,7 @@ there is no NCCL/MPI dependency to replace.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Mapping, Optional
 
 import jax
@@ -54,6 +55,30 @@ def initialize(
     return
   explicit = (coordinator_address is not None or num_processes is not None
               or process_id is not None)
+  if explicit and (num_processes or 0) > 1 and (
+      os.environ.get("JAX_PLATFORMS", "").startswith("cpu")):
+    # Chipless multi-controller bring-up (ISSUE 19): the CPU backend's
+    # default cross-process collectives tier is "none", which makes
+    # every computation spanning processes fail to compile
+    # ("Multiprocess computations aren't implemented"). jaxlib ships a
+    # gloo TCP tier that rides the same coordination service — select
+    # it here, while the backend is still uninitialized (this function
+    # is documented as the process's first JAX call, so this is the
+    # one place the flag can still take effect). Real TPU/GPU pods
+    # never enter this branch: their collectives are ICI/NCCL-native.
+    try:
+      jax.config.update("jax_cpu_collectives_implementation", "gloo")
+      # Gloo pairs assume one in-flight collective per context; the CPU
+      # client's async dispatch can issue two differently-sized
+      # collectives back-to-back and cross their wire frames
+      # ("op.preamble.length <= op.nbytes" aborts). Synchronous
+      # dispatch serializes issue order — correctness over overlap on
+      # this emulation tier.
+      jax.config.update("jax_cpu_enable_async_dispatch", False)
+    except Exception:  # older jaxlib without the gloo tier
+      _log.warning("CPU gloo collectives unavailable; cross-process "
+                   "programs will not compile on this backend.",
+                   exc_info=True)
   try:
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -155,3 +180,48 @@ def sync_global_devices(name: str) -> None:
   """Cross-host barrier (reference: implicit session-run sync points)."""
   from jax.experimental import multihost_utils
   multihost_utils.sync_global_devices(name)
+
+
+def global_put(tree, shardings):
+  """Places a host-local pytree onto (possibly cross-process) shardings.
+
+  Single-process this IS `jax.device_put` — byte-for-byte the r17
+  oracle path. Multi-process, `device_put` refuses shardings whose
+  device set spans processes, so each leaf is assembled with
+  `jax.make_array_from_callback` against the full local value: every
+  process holds the identical full array (true for everything this
+  repo places at bring-up — seeded env/ring init, replicated target
+  variables, dispatch counters) and contributes exactly the index
+  slices its local devices own. Correct for BOTH replicated and
+  axis-split shardings, which is why this is the one placement
+  primitive (`make_array_from_process_local_data` would need the
+  per-process slice pre-cut for the split case).
+
+  Args:
+    tree: pytree of host/np/jnp arrays, identical on every process.
+    shardings: one `jax.sharding.Sharding` applied to every leaf, or a
+      pytree of shardings matching `tree`'s structure.
+  """
+  if jax.process_count() == 1:
+    return jax.device_put(tree, shardings)
+
+  def place(leaf, sharding):
+    arr = np.asarray(leaf)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+  if isinstance(shardings, jax.sharding.Sharding):
+    return jax.tree_util.tree_map(lambda leaf: place(leaf, shardings), tree)
+  return jax.tree_util.tree_map(place, tree, shardings)
+
+
+def global_scalar(value, mesh, dtype=None):
+  """A replicated GLOBAL scalar on `mesh` (multi-process jit operands
+  must be global arrays even when every shard holds the same value —
+  the dispatch-counter seam of the fused loops). Single-process this
+  is a plain `jnp.asarray`, the unchanged oracle path."""
+  import jax.numpy as jnp
+  arr = jnp.asarray(value, dtype)
+  if jax.process_count() == 1:
+    return arr
+  return global_put(arr, mesh_lib.replicated_sharding(mesh))

@@ -24,6 +24,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
+try:  # promoted to jax.shard_map in newer releases
+  from jax import shard_map
+except ImportError:
+  from jax.experimental.shard_map import shard_map
+
 
 class MoEParams(NamedTuple):
   """Router + stacked expert FFN weights.
@@ -190,7 +195,7 @@ def expert_parallel_moe(
       w1=PartitionSpec(axis), b1=PartitionSpec(axis),
       w2=PartitionSpec(axis), b2=PartitionSpec(axis),
   )
-  fn = jax.shard_map(
+  fn = shard_map(
       functools.partial(_ep_local, axis_name=axis, capacity=capacity),
       mesh=mesh,
       in_specs=(token_spec, param_specs),

@@ -26,6 +26,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
+try:  # promoted to jax.shard_map in newer releases
+  from jax import shard_map
+except ImportError:
+  from jax.experimental.shard_map import shard_map
+
 
 def stack_stage_params(params_per_stage: Sequence[Any]) -> Any:
   """Stacks per-stage param pytrees (identical structure) along a new
@@ -85,8 +90,10 @@ def _pipeline_local(stacked_params, microbatches, *, stage_fn,
 
   # Mark the carried buffers device-varying up front (they depend on
   # axis_index from the first tick) for shard_map's VMA type check.
+  _pcast = getattr(jax.lax, "pcast",
+                   lambda x, axes, to: x)  # pre-VMA jax: no-op
   varying = lambda tree: jax.tree_util.tree_map(
-      lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), tree)
+      lambda x: _pcast(x, (axis_name,), to="varying"), tree)
   init = (varying(zeros_like_out), varying(outputs))
   _, outputs = jax.lax.fori_loop(
       0, num_microbatches + num_stages - 1, tick, init)
@@ -137,7 +144,7 @@ def pipeline_apply(
       lambda x: x.reshape((m, b // m) + x.shape[1:]), batch)
 
   params_spec = PartitionSpec(axis)
-  fn = jax.shard_map(
+  fn = shard_map(
       functools.partial(_pipeline_local, stage_fn=stage_fn,
                         axis_name=axis),
       mesh=mesh,

@@ -23,6 +23,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
+try:  # promoted to jax.shard_map in newer releases
+  from jax import shard_map
+except ImportError:
+  from jax.experimental.shard_map import shard_map
+
 
 def _ring_attention_local(
     q: jnp.ndarray,
@@ -81,7 +86,9 @@ def _ring_attention_local(
   # axis_index — and on the batch shard when batch-sharded — from the
   # first iteration) for shard_map's VMA type check.
   vary_axes = (axis_name,) + ((batch_axis,) if batch_axis else ())
-  varying = lambda x: jax.lax.pcast(x, vary_axes, to="varying")
+  _pcast = getattr(jax.lax, "pcast",
+                   lambda x, axes, to: x)  # pre-VMA jax: no-op
+  varying = lambda x: _pcast(x, vary_axes, to="varying")
   init = (
       k, v,
       varying(jnp.full((b, h, t_local), -jnp.inf, jnp.float32)),
@@ -130,7 +137,7 @@ def ring_attention(
   if scale is None:
     scale = 1.0 / math.sqrt(q.shape[-1])
   spec = PartitionSpec(batch_axis, axis, None, None)
-  fn = jax.shard_map(
+  fn = shard_map(
       functools.partial(_ring_attention_local, axis_name=axis,
                         causal=causal, scale=scale,
                         batch_axis=batch_axis),

@@ -84,6 +84,7 @@ import jax.numpy as jnp
 
 from tensor2robot_tpu.obs import ledger as obs_ledger
 from tensor2robot_tpu.obs import trace as trace_lib
+from tensor2robot_tpu.parallel import distributed as dist_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.replay.bellman import (TargetNetwork,
                                              make_bellman_targets_fn,
@@ -228,7 +229,10 @@ class AnakinLoop(TargetNetwork):
     self._env_shardings = env.state_shardings(self.mesh, self._data_axis)
     env_state = env.init_state(jax.random.key(seed + 21))
     if self._sharded:
-      env_state = jax.device_put(env_state, self._env_shardings)
+      # global_put IS device_put single-process; multi-process (ISSUE
+      # 19) it assembles each leaf as a global array from the identical
+      # seeded init every process computes.
+      env_state = dist_lib.global_put(env_state, self._env_shardings)
     self._env_state = env_state
     # Device counters snapshot (dispatch granularity, no mid-scan D2H).
     self.env_steps = 0
@@ -253,6 +257,46 @@ class AnakinLoop(TargetNetwork):
   @property
   def successes(self) -> int:
     return int(jax.device_get(self._env_state.successes))
+
+  # --- fused crash-resume (ISSUE 19: the donated state's only seam) --------
+
+  def checkpoint_state(self):
+    """The carried device state as one pytree for the checkpoint
+    manager — env fleet, replay ring, target net, exactly the arrays
+    the donated executable threads between dispatches. Taken BETWEEN
+    dispatches (the only moment the donated buffers are live on the
+    host side of the seam). TrainState stays with the caller (the loop
+    owns it), completing the composite."""
+    return {
+        "env": self._env_state,
+        "buffer": self._buffer.state,
+        "target": self._target_variables,
+    }
+
+  def checkpoint_meta(self):
+    """Host counters the device pytree does not carry (episodes and
+    successes DO live in the env state and restore with it)."""
+    return {
+        "outer": self._outer,
+        "env_steps": self.env_steps,
+        "trained_steps": self.trained_steps,
+        "refresh_count": self._refresh_count,
+        "last_refresh_step": self.last_refresh_step,
+    }
+
+  def restore_checkpoint_state(self, composite, meta) -> None:
+    """Installs a restored composite (arrays already placed on THIS
+    loop's shardings by the checkpoint manager's template restore) and
+    replays the host counters, so the next dispatch continues the
+    (seed, outer, inner) RNG streams exactly where the crash cut them."""
+    self._env_state = composite["env"]
+    self._buffer.set_state(composite["buffer"])
+    self._target_variables = composite["target"]
+    self._outer = int(meta["outer"])
+    self.env_steps = int(meta["env_steps"])
+    self.trained_steps = int(meta["trained_steps"])
+    self._refresh_count = int(meta["refresh_count"])
+    self.last_refresh_step = int(meta["last_refresh_step"])
 
   # --- the fused program ---------------------------------------------------
 
@@ -457,7 +501,8 @@ class AnakinLoop(TargetNetwork):
           return ts, env_state, buffer_state, metrics
 
       args = (train_state, self._env_state, self._buffer.state,
-              self._target_variables, jnp.zeros((), jnp.int32))
+              self._target_variables,
+              dist_lib.global_scalar(0, self.mesh, jnp.int32))
       self._exec = jax.jit(
           fn, donate_argnums=(0, 1, 2)).lower(*args).compile()
       self.compile_counts["anakin_step"] = (
@@ -486,7 +531,8 @@ class AnakinLoop(TargetNetwork):
       t0 = time.perf_counter()
       train_state, env_state, buffer_state, metrics = exec_(
           train_state, self._env_state, self._buffer.state,
-          self._target_variables, jnp.asarray(self._outer, jnp.int32))
+          self._target_variables,
+          dist_lib.global_scalar(self._outer, self.mesh, jnp.int32))
       # device_get blocks until the fused program finishes: the clock
       # stops exactly at the end of device work + the scalar D2H, so the
       # bookkeeping below is measurable host time, not hidden inside the

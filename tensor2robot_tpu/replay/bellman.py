@@ -174,7 +174,11 @@ class TargetNetwork:
   def _place(self, variables):
     if self._target_sharding is None:
       return variables
-    return jax.device_put(variables, self._target_sharding)
+    # global_put IS device_put single-process; multi-process (ISSUE 19)
+    # the replicated target must be a GLOBAL array — every process
+    # holds the identical refreshed copy and contributes its shards.
+    from tensor2robot_tpu.parallel import distributed as dist_lib
+    return dist_lib.global_put(variables, self._target_sharding)
 
   def refresh(self, variables, step: int) -> None:
     """Pulls the online variables into the target net (lag or polyak;
@@ -183,9 +187,15 @@ class TargetNetwork:
       target = jax.tree_util.tree_map(jnp.copy, variables)
     else:
       tau = self._polyak_tau
+      old_target = self._target_variables
+      if jax.process_count() > 1:
+        # Eager arithmetic on process-spanning arrays raises; the
+        # target is replicated, so each process blends its own full
+        # host copy and _place reassembles the global array.
+        old_target = jax.tree_util.tree_map(np.asarray, old_target)
       target = jax.tree_util.tree_map(
           lambda online, target: tau * online + (1.0 - tau) * target,
-          variables, self._target_variables)
+          variables, old_target)
     self._target_variables = self._place(target)
     self._refresh_count += 1
     self.last_refresh_step = int(step)

@@ -55,6 +55,7 @@ import numpy as np
 
 from tensor2robot_tpu.obs import ledger as obs_ledger
 from tensor2robot_tpu.obs import trace as trace_lib
+from tensor2robot_tpu.parallel import distributed as dist_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.replay.bellman import (TargetNetwork,
                                              make_bellman_targets_fn,
@@ -287,7 +288,10 @@ class DeviceReplayBuffer:
         tree=jnp.zeros((tree_len,), jnp.float32),
         max_priority=jnp.ones((), jnp.float32),
     )
-    return jax.device_put(state, self.state_shardings())
+    # global_put IS device_put single-process; multi-process (ISSUE 19)
+    # the zero-filled ring must assemble as GLOBAL arrays over the
+    # cross-process capacity sharding.
+    return dist_lib.global_put(state, self.state_shardings())
 
   def state_shardings(self):
     """Sharding pytree for DeviceReplayState: capacity-axis arrays over
@@ -780,6 +784,38 @@ class MegastepLearner(TargetNetwork):
     self._outer = 0
     self._label_seed = 0
 
+  # --- fused crash-resume (ISSUE 19: the donated state's only seam) --------
+
+  def checkpoint_state(self):
+    """The carried device state as one pytree for the checkpoint
+    manager — replay ring + target net, the arrays the donated
+    executable threads between dispatches (TrainState stays with the
+    caller, completing the composite)."""
+    return {
+        "buffer": self._buffer.state,
+        "target": self._target_variables,
+    }
+
+  def checkpoint_meta(self):
+    """Host counters driving the (outer, label_seed) RNG streams."""
+    return {
+        "outer": self._outer,
+        "label_seed": self._label_seed,
+        "refresh_count": self._refresh_count,
+        "last_refresh_step": self.last_refresh_step,
+    }
+
+  def restore_checkpoint_state(self, composite, meta) -> None:
+    """Installs a restored composite and replays the host counters so
+    the next dispatch continues the RNG streams where the crash cut
+    them."""
+    self._buffer.set_state(composite["buffer"])
+    self._target_variables = composite["target"]
+    self._outer = int(meta["outer"])
+    self._label_seed = int(meta["label_seed"])
+    self._refresh_count = int(meta["refresh_count"])
+    self.last_refresh_step = int(meta["last_refresh_step"])
+
   # --- the fused program ---------------------------------------------------
 
   def _build_megastep_fn(self):
@@ -865,7 +901,8 @@ class MegastepLearner(TargetNetwork):
           return ts, buffer_state, metrics
 
       args = (train_state, self._buffer.state, self._target_variables,
-              jnp.zeros((), jnp.int32), jnp.zeros((), jnp.uint32))
+              dist_lib.global_scalar(0, self._trainer.mesh, jnp.int32),
+              dist_lib.global_scalar(0, self._trainer.mesh, jnp.uint32))
       self._exec = jax.jit(
           fn, donate_argnums=(0, 1)).lower(*args).compile()
       self.compile_counts["megastep"] = (
@@ -894,8 +931,10 @@ class MegastepLearner(TargetNetwork):
       train_state, buffer_state, metrics = exec_(
           train_state, self._buffer.state,
           self._target_variables,
-          jnp.asarray(self._outer, jnp.int32),
-          jnp.asarray(self._label_seed, jnp.uint32))
+          dist_lib.global_scalar(self._outer, self._trainer.mesh,
+                                 jnp.int32),
+          dist_lib.global_scalar(self._label_seed, self._trainer.mesh,
+                                 jnp.uint32))
       # The device_get below blocks on the scanned program's metrics, so
       # the measured window covers device work + the scalar D2H.
       metrics = jax.device_get(metrics)

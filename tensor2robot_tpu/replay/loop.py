@@ -408,12 +408,21 @@ class ReplayLoopConfig:
   # rejected with a flightrec record and older steps tried) and
   # continues from its exact step; with nothing valid on disk it
   # starts fresh (the preemption-tolerant default: "resume if you
-  # can"). Host path only for now: the fused device paths' state
-  # lives inside donated device buffers, and checkpointing them is
-  # the multi-controller work ROADMAP item 1 scopes.
+  # can"). Since ISSUE 19 the FUSED device paths checkpoint too: the
+  # donated anakin/megastep state's only host seam is between
+  # dispatches, so the loop barriers there and writes the whole
+  # carried composite (TrainState + env fleet + replay ring + target
+  # net) through the orbax manager — every process contributes its
+  # shards — with a primary-only sidecar stamping counters, mesh
+  # geometry, and process count (restore refuses a mismatched
+  # geometry with the fix named). `checkpoint_dir` overrides the
+  # default <logdir>/checkpoints root: multi-process runs keep
+  # per-process logdirs but MUST share one checkpoint root (each
+  # process holds only its shards of the global arrays).
   checkpoint_every: int = 0
   checkpoint_keep: int = 3
   resume: bool = False
+  checkpoint_dir: Optional[str] = None
   # Training-health sentinel (ISSUE 15, obs/health.py). health=True
   # (the default: unattended operation is the ROADMAP item 1 operating
   # mode) computes the fixed per-learn-iteration health summary —
@@ -470,14 +479,6 @@ class ReplayTrainLoop:
     # Fault seam (ISSUE 14): the ONE point a scheduled learner `crash`
     # enters this loop — checked per optimizer step on the host path.
     self._faults = fault_plan
-    if (config.checkpoint_every or config.resume) and (
-        config.device_resident or config.anakin):
-      raise ValueError(
-          "checkpoint_every/resume cover the host path: the fused "
-          "device paths' replay/env state lives inside donated device "
-          "buffers (checkpointing them is the multi-controller work "
-          "ROADMAP item 1 scopes). Run without device_resident/anakin "
-          "to use crash-resume.")
     self.model = model if model is not None else self._default_model()
     # Observability spine (ISSUE 11): one ExecutableLedger per loop run
     # (every compiled program this loop owns registers + records
@@ -582,7 +583,8 @@ class ReplayTrainLoop:
     self._ckpt_manager = None
     if config.checkpoint_every or config.resume:
       from tensor2robot_tpu.train.checkpoints import CheckpointManager
-      self.checkpoint_root = os.path.join(logdir, "checkpoints")
+      self.checkpoint_root = (config.checkpoint_dir
+                              or os.path.join(logdir, "checkpoints"))
       # Synchronous saves: the sidecar finalizes AFTER the orbax step
       # does, so sidecar-present implies whole-checkpoint-usable.
       self._ckpt_manager = CheckpointManager(
@@ -950,6 +952,86 @@ class ReplayTrainLoop:
     self.recorder.record("event", "loop_resumed", step=int(step))
     return state, trees, meta
 
+  # --- fused-path checkpoints (ISSUE 19) -----------------------------------
+
+  def _save_fused_checkpoint(self, step: int, state, learner,
+                             initial_eval: Dict,
+                             eval_history: List) -> None:
+    """Between-dispatch checkpoint for the donated anakin/megastep
+    state — the fused paths' ONLY host seam. Every process barriers,
+    then writes its shards of the whole carried composite (TrainState
+    + env/ring/target device pytrees) through the orbax manager; the
+    primary alone stamps the sidecar meta (host counters, fingerprint,
+    mesh geometry, process count) so sidecar-present still implies
+    whole-checkpoint-usable."""
+    import jax
+    from tensor2robot_tpu.parallel import distributed as dist_lib
+    from tensor2robot_tpu.train import checkpoints as checkpoints_lib
+    with trace_lib.span("replay/fused_checkpoint", step=step):
+      dist_lib.sync_global_devices(f"fused_ckpt_save_{step}")
+      composite = {"train_state": state, **learner.checkpoint_state()}
+      self._ckpt_manager.save(step, composite, force=True)
+      self._ckpt_manager.wait()
+      meta = {
+          "fingerprint": self._checkpoint_fingerprint(),
+          "fused": learner.checkpoint_meta(),
+          "initial_eval": initial_eval,
+          "eval_history": eval_history,
+          # Geometry + process stamps: the device composite restores
+          # shard-for-shard, so a different mesh OR process count must
+          # refuse up front with the fix named.
+          "mesh": checkpoints_lib.mesh_geometry(self.trainer.mesh),
+          "processes": jax.process_count(),
+      }
+      if dist_lib.is_primary():
+        checkpoints_lib.save_sidecar(self.checkpoint_root, step,
+                                     meta=meta)
+        checkpoints_lib.prune_sidecars(self.checkpoint_root,
+                                       self._ckpt_manager.all_steps())
+      dist_lib.sync_global_devices(f"fused_ckpt_done_{step}")
+    self.recorder.record("event", "loop_checkpoint", step=step,
+                         fused=True)
+
+  def _restore_fused_checkpoint(self, state, learner):
+    """Restores the newest VALID fused checkpoint into the learner's
+    carried state; returns (state, step, meta) or None when nothing
+    valid exists (fresh start — the preemption-tolerant default).
+    The learner's freshly initialized checkpoint_state() is the
+    restore TEMPLATE: its leaves carry THIS run's shardings, so orbax
+    reassembles every process's shards onto exactly the placement the
+    next dispatch lowers against."""
+    import jax
+    from tensor2robot_tpu.train import checkpoints as checkpoints_lib
+    step = checkpoints_lib.latest_resumable_step(
+        self.checkpoint_root, recorder=self.recorder)
+    if step is None:
+      return None
+    _, _, meta = checkpoints_lib.load_sidecar(self.checkpoint_root, step)
+    fingerprint = self._checkpoint_fingerprint()
+    if meta.get("fingerprint") != fingerprint:
+      raise ValueError(
+          "resume fingerprint mismatch: checkpoint was written by "
+          f"{meta.get('fingerprint')}, this loop is {fingerprint} — "
+          "resume needs an identically configured loop (shapes would "
+          "drift otherwise)")
+    checkpoints_lib.validate_restore_mesh(meta.get("mesh"),
+                                          self.trainer.mesh)
+    saved_procs = int(meta.get("processes", 1))
+    if saved_procs != jax.process_count():
+      raise ValueError(
+          f"fused checkpoint step {step} was written by {saved_procs} "
+          f"process(es); this run has {jax.process_count()}. The "
+          "device composite restores shard-for-shard, so relaunch "
+          f"with {saved_procs} processes on the same mesh geometry "
+          f"{meta.get('mesh')} (or start fresh with resume=False).")
+    template = {"train_state": state, **learner.checkpoint_state()}
+    composite = self._ckpt_manager.restore(template, step=step)
+    state = composite.pop("train_state")
+    learner.restore_checkpoint_state(composite, meta["fused"])
+    self.recorder.record("event", "loop_resumed", step=int(step),
+                         fused=True)
+    return state, int(step), meta
+
   # --- the loop ------------------------------------------------------------
 
   def run(self, num_steps: int) -> Dict:
@@ -1229,22 +1311,35 @@ class ReplayTrainLoop:
     # this counts as refresh 0, not a loop refresh.
     learner.refresh(host_variables, step=0)
 
+    # Fused crash-resume (ISSUE 19): the freshly initialized learner is
+    # the restore template; nothing valid on disk means a fresh start.
+    resume_step, resume_meta = 0, None
+    if c.resume and self._ckpt_manager is not None:
+      restored = self._restore_fused_checkpoint(state, learner)
+      if restored is not None:
+        state, resume_step, resume_meta = restored
+        host_variables = self._host_variables(state)
+        predictor.update(host_variables)
+
     self._start_collectors(policy)
     profile_hook = self._profile_hook()
 
     try:
       self._wait_for_min_fill()
       eval_batches, eval_q_stars = self._eval_transitions()
-      online = state.variables(use_ema=True)
-      initial_eval = self._eval(updater, online, eval_batches,
-                                eval_q_stars)
-      self._emit(0, {"replay/" + key: v
-                     for key, v in initial_eval.items()})
-
-      eval_history = [dict(step=0, **initial_eval)]
+      if resume_meta is not None:
+        initial_eval = resume_meta.get("initial_eval") or {}
+        eval_history = list(resume_meta.get("eval_history") or [])
+      else:
+        online = state.variables(use_ema=True)
+        initial_eval = self._eval(updater, online, eval_batches,
+                                  eval_q_stars)
+        self._emit(0, {"replay/" + key: v
+                       for key, v in initial_eval.items()})
+        eval_history = [dict(step=0, **initial_eval)]
       final_metrics: Dict[str, float] = {}
-      prev_step = 0
-      for outer in range(1, num_outer + 1):
+      prev_step = resume_step
+      for outer in range(resume_step // k + 1, num_outer + 1):
         with trace_lib.span("extend/drain"):
           self.feeder.drain()
         self._feeder_hb.beat()
@@ -1299,6 +1394,10 @@ class ReplayTrainLoop:
           eval_history.append(dict(step=step, **evals))
           self._emit(step,
                      {"replay/" + key: v for key, v in evals.items()})
+        if (self._ckpt_manager is not None and c.checkpoint_every
+            and crossed(c.checkpoint_every)):
+          self._save_fused_checkpoint(step, state, learner,
+                                      initial_eval, eval_history)
         prev_step = step
     finally:
       self._profile_step(profile_hook, num_outer * k, final=True)
@@ -1368,14 +1467,26 @@ class ReplayTrainLoop:
     loop.refresh(host_variables, step=0)
     profile_hook = self._profile_hook()
 
-    eval_batches, eval_q_stars = self._eval_transitions()
-    initial_eval = self._eval(updater, state.variables(use_ema=True),
-                              eval_batches, eval_q_stars)
-    self._emit(0, {"replay/" + key: v
-                   for key, v in initial_eval.items()})
+    # Fused crash-resume (ISSUE 19): the freshly initialized loop is
+    # the restore template (its checkpoint_state() leaves carry this
+    # run's shardings); nothing valid on disk means a fresh start.
+    resume_step, resume_meta = 0, None
+    if c.resume and self._ckpt_manager is not None:
+      restored = self._restore_fused_checkpoint(state, loop)
+      if restored is not None:
+        state, resume_step, resume_meta = restored
 
-    eval_history = [dict(step=0, **initial_eval)]
-    prev_step = 0
+    eval_batches, eval_q_stars = self._eval_transitions()
+    if resume_meta is not None:
+      initial_eval = resume_meta.get("initial_eval") or {}
+      eval_history = list(resume_meta.get("eval_history") or [])
+    else:
+      initial_eval = self._eval(updater, state.variables(use_ema=True),
+                                eval_batches, eval_q_stars)
+      self._emit(0, {"replay/" + key: v
+                     for key, v in initial_eval.items()})
+      eval_history = [dict(step=0, **initial_eval)]
+    prev_step = resume_step
     # Dispatch bound: warm-up (min-fill at total_envs per control
     # step) plus the training budget, doubled — a failure to progress
     # raises instead of spinning.
@@ -1438,6 +1549,10 @@ class ReplayTrainLoop:
           eval_history.append(dict(step=step, **evals))
           self._emit(step,
                      {"replay/" + key: v for key, v in evals.items()})
+        if (self._ckpt_manager is not None and c.checkpoint_every
+            and crossed(c.checkpoint_every)):
+          self._save_fused_checkpoint(step, state, loop,
+                                      initial_eval, eval_history)
         prev_step = step
     finally:
       self._profile_step(profile_hook, loop.trained_steps, final=True)

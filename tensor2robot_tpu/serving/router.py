@@ -309,6 +309,10 @@ class FleetRouter:
     # data. None (the default) keeps serving capture-free.
     self._episode_recorder = episode_recorder
     self._started_at = time.perf_counter()
+    # Never-started guard (ISSUE 19): warmup() compiles but does not
+    # start the batchers, so a submit before start() must raise typed
+    # instead of shedding every request as an anonymous replica fault.
+    self._started = False
     self.replicas = []
     self._breakers = []
     for device in devices:
@@ -410,6 +414,7 @@ class FleetRouter:
   # -- lifecycle -----------------------------------------------------------
 
   def start(self) -> "FleetRouter":
+    self._started = True
     for replica in self.replicas:
       replica.batcher.start()
     return self
@@ -484,6 +489,8 @@ class FleetRouter:
     exactly one per submit — ISSUE 18 — so flywheel episode accounting
     reconciles against serving stats without client-side bookkeeping.)
     """
+    if not self._started:
+      raise slo_lib.RouterNotStarted()
     if slo is not None and deadline_at is None:
       deadline_at = time.perf_counter() + slo.deadline_ms / 1e3
     seed = self.assign_seed() if seed is None else int(seed)

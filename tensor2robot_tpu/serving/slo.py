@@ -84,6 +84,25 @@ class RequestShed(RuntimeError):
     super().__init__(message)
 
 
+class RouterNotStarted(RuntimeError):
+  """Raised by ``FleetRouter.submit`` on a router that was never
+  started. Before ISSUE 19 this footgun was silent and misleading:
+  ``warmup()`` compiles the ladder executables but does NOT start the
+  batcher dispatch threads, so a submit on a warmed-but-unstarted
+  router fell into the replica-fault path and every request came back
+  as an anonymous ``RequestShed(class, "fault")`` — a fleet that looks
+  overloaded when it was simply never switched on. A router that WAS
+  started and then stopped keeps the old semantics (stopped batchers
+  count as replica faults): only the never-started case is typed."""
+
+  def __init__(self):
+    super().__init__(
+        "FleetRouter was never started: warmup() only compiles the "
+        "ladder executables, it does not start the batcher dispatch "
+        "threads. Call start() (or use the router as a context "
+        "manager) before submit().")
+
+
 class DispatcherDead(RuntimeError):
   """Resolved into every pending Future when a MicroBatcher's
   dispatcher thread dies unrecoverably (restart budget exhausted, or a

@@ -56,6 +56,23 @@ class TestExportUtils:
     tmp2, final2 = export_utils.versioned_export_dir(root)
     assert int(os.path.basename(final2)) > int(os.path.basename(final1))
 
+  def test_publish_refuses_existing_target_by_name(self, tmp_path):
+    """ISSUE 19 regression: a reused workdir re-reaching a step-named
+    export dir used to die with a bare OSError errno 39 (directory not
+    empty) naming neither path; publish now refuses up front with the
+    offending path in the message."""
+    root = str(tmp_path / "exports")
+    final = os.path.join(root, "1234")
+    os.makedirs(os.path.join(final, "old_contents"))
+    tmp = os.path.join(root, ".tmp-1234")
+    os.makedirs(tmp)
+    with pytest.raises(FileExistsError, match="1234"):
+      export_utils.publish(tmp, final)
+    # The refused publish leaves both dirs intact: nothing clobbered,
+    # nothing half-moved.
+    assert os.path.isdir(os.path.join(final, "old_contents"))
+    assert os.path.isdir(tmp)
+
   def test_gc(self, tmp_path):
     root = str(tmp_path / "exports")
     for v in (100, 200, 300):

@@ -642,13 +642,75 @@ class TestLearnerResume:
     result = ReplayTrainLoop(config, str(tmp_path), model=model).run(10)
     assert result["steps"] == 10
 
-  def test_fused_paths_refuse_checkpointing(self, tmp_path):
+  def test_fused_anakin_checkpoint_then_resume(self, tmp_path):
+    """ISSUE 19: the fused anakin path checkpoints its donated carried
+    state between dispatches and a fresh loop resumes from it — the
+    interrupted run's counters continue (no re-warm-up) and the ledger
+    stays exactly-once."""
+    import optax
+
     from tensor2robot_tpu.replay.loop import (ReplayLoopConfig,
                                               ReplayTrainLoop)
-    with pytest.raises(ValueError, match="host path"):
-      ReplayTrainLoop(
-          ReplayLoopConfig(anakin=True, checkpoint_every=10),
-          str(tmp_path))
+    from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+    logdir = str(tmp_path)
+
+    def make_loop(resume=False):
+      config = ReplayLoopConfig(
+          seed=0, anakin=True, checkpoint_every=5, resume=resume,
+          eval_every=1000, log_every=1000, mesh_dp=1, mesh_tp=1,
+          min_fill=96)
+      model = TinyQCriticModel(
+          image_size=config.image_size,
+          action_size=config.action_size,
+          optimizer_fn=lambda: optax.adam(config.learning_rate))
+      return ReplayTrainLoop(config, logdir, model=model)
+
+    first = make_loop().run(10)
+    # Warm-up dispatch trains 3 steps (min-fill crosses mid-scan), then
+    # 5 per dispatch: 3 → 8 → 13 ≥ 10 stops the run at 13.
+    assert first["steps"] == 13
+    resumed_loop = make_loop(resume=True)
+    result = resumed_loop.run(15)
+    # Restored at 13 (the newest checkpoint), then ONE more dispatch
+    # (anakin_inner/train_every = 5 optimizer steps) finishes the run.
+    assert result["steps"] == 18
+    assert all(v == 1 for v in result["compile_counts"].values()), (
+        result["compile_counts"])
+    # env_steps continue from the restored counter, not from zero: the
+    # resumed run dispatched once on top of the checkpoint's state.
+    assert result["env_steps_collected"] > first["env_steps_collected"]
+
+  def test_fused_resume_refuses_process_count_mismatch(self, tmp_path):
+    """The sidecar stamps the writing process count; a fused restore
+    under a different count must refuse with the fix named (the device
+    composite restores shard-for-shard)."""
+    import optax
+
+    from tensor2robot_tpu.replay.loop import (ReplayLoopConfig,
+                                              ReplayTrainLoop)
+    from tensor2robot_tpu.replay.smoke import TinyQCriticModel
+    from tensor2robot_tpu.train import checkpoints as checkpoints_lib
+    logdir = str(tmp_path)
+
+    def make_loop(resume=False):
+      config = ReplayLoopConfig(
+          seed=0, anakin=True, checkpoint_every=5, resume=resume,
+          eval_every=1000, log_every=1000, mesh_dp=1, mesh_tp=1,
+          min_fill=96)
+      model = TinyQCriticModel(
+          image_size=config.image_size,
+          action_size=config.action_size,
+          optimizer_fn=lambda: optax.adam(config.learning_rate))
+      return ReplayTrainLoop(config, logdir, model=model)
+
+    make_loop().run(5)
+    root = os.path.join(logdir, "checkpoints")
+    step = checkpoints_lib.latest_resumable_step(root)
+    _, _, meta = checkpoints_lib.load_sidecar(root, step)
+    meta["processes"] = 2  # forge a 2-process writer
+    checkpoints_lib.save_sidecar(root, step, meta=meta)
+    with pytest.raises(ValueError, match="2 process"):
+      make_loop(resume=True).run(10)
 
 
 # -- CLI + committed artifact -----------------------------------------------

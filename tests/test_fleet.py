@@ -147,6 +147,24 @@ class TestFleetRouter:
     assert not errors, errors
     assert min(flushed.values()) > 0, flushed
 
+  def test_warmed_but_unstarted_router_raises_typed(self, tiny_predictor):
+    """ISSUE 19 satellite: warmup() compiles the ladders but does NOT
+    start the batcher dispatch threads; submit() on a warmed-but-
+    unstarted router used to shed every request with an opaque
+    "MicroBatcher is not running". It now fails fast with a typed
+    error that names start()."""
+    from tensor2robot_tpu.serving.slo import RouterNotStarted
+
+    router = _make_router(tiny_predictor, n_devices=2)
+    router.warmup(tiny_predictor.make_image)
+    with pytest.raises(RouterNotStarted, match="start\\(\\)"):
+      router.submit(tiny_predictor.make_image(0))
+    # The same router serves normally once actually started (the
+    # context manager calls start()).
+    with router:
+      action = router.act(tiny_predictor.make_image(0), timeout=30)
+    assert np.asarray(action).shape == (4,)
+
   def test_router_ingress_deadline_survives_hop(self, tiny_predictor):
     """The class budget is stamped at router ingress: a deadline the
     ingress clock already consumed is shed by the replica as expired,

@@ -1158,6 +1158,130 @@ class TestAggregate:
     assert stall["component"] == "replay/learner"
 
 
+class TestFrontDoor:
+  """ISSUE 19 tentpole (c): the router-of-routers front door over two
+  EMULATED hosts in one process — each "host" a FleetRouter with its
+  own isolated registry, both over the SAME device subset so their
+  replica (device) names collide on purpose. The aggregate must link
+  request flows across the front-door hop (the door's private tracer
+  lane vs the hosts' process lane) and keep the same-named devices on
+  different hosts distinct in the fleet Q-drift view."""
+
+  @pytest.fixture(scope="class")
+  def pod(self, tmp_path_factory):
+    import numpy as np
+
+    import jax
+
+    from tensor2robot_tpu.serving.frontdoor import FrontDoor
+    from tensor2robot_tpu.serving.router import FleetRouter
+    from tensor2robot_tpu.serving.smoke import TinyQPredictor
+    from tensor2robot_tpu.serving.stats import ServingStats
+
+    logdir = tmp_path_factory.mktemp("pod")
+    predictor = TinyQPredictor(image_size=8, action_size=4, seed=0)
+    devices = jax.devices()[:2]
+    registries, hosts = {}, {}
+    for name in ("hostA", "hostB"):
+      registry = MetricRegistry()
+      registries[name] = registry
+      hosts[name] = FleetRouter(
+          predictor, devices=devices, num_samples=16, num_elites=4,
+          iterations=2, seed=0, ladder_sizes=(1, 2),
+          stats=ServingStats(registry=registry))
+    door = FrontDoor(hosts)
+    door.warmup(predictor.make_image)
+    with door:
+      futures = [door.submit(predictor.make_image(i))
+                 for i in range(12)]
+      for future in futures:
+        assert np.asarray(future.result(timeout=30)).shape == (4,)
+      yield {"door": door, "predictor": predictor,
+             "registries": registries, "logdir": str(logdir),
+             "devices": [str(device) for device in devices]}
+
+  def test_flows_cross_the_hop_and_hosts_stay_distinct(self, pod):
+    from tensor2robot_tpu.obs import trace as trace_lib
+    from tensor2robot_tpu.obs.aggregate import aggregate_logdir
+
+    door = pod["door"]
+    snap = door.snapshot()
+    assert snap["submitted"] >= 12
+    assert snap["reconciled"], snap
+    # The rotating tie-break spread idle-pod traffic over both hosts.
+    assert all(entry["submitted"] > 0
+               for entry in snap["hosts"].values()), snap["hosts"]
+    # Per-emulated-host streams: each host's isolated registry under
+    # its own host label (the export_snapshot override), the hosts'
+    # serve spans from the process tracer, and the door's OWN lane.
+    logdir = pod["logdir"]
+    for name, registry in pod["registries"].items():
+      host_dir = os.path.join(logdir, name)
+      os.makedirs(host_dir, exist_ok=True)
+      registry.export_snapshot(
+          os.path.join(host_dir, "registry.json"), host=name)
+    hosts_dir = os.path.join(logdir, "hostpool")
+    os.makedirs(hosts_dir, exist_ok=True)
+    trace_lib.get_tracer().export_chrome_trace(
+        os.path.join(hosts_dir, "trace.json"))
+    door_dir = os.path.join(logdir, "frontdoor")
+    os.makedirs(door_dir, exist_ok=True)
+    door.export_trace(os.path.join(door_dir, "trace.json"))
+    fleet = aggregate_logdir(logdir)
+    # Every front-door request has its ingress span in the door's lane
+    # and its enqueue/flush/dispatch spans in the hosts' lane — the
+    # merged flow visibly crosses the hop.
+    assert fleet["trace"]["cross_process_flows"] >= 12, fleet["trace"]
+    # Same-named devices on different hosts stay distinct drift keys.
+    replicas = fleet["health"]["q_drift"]["replicas"]
+    for device in pod["devices"]:
+      owners = sorted(key.split("/", 1)[0] for key in replicas
+                      if key.endswith(f"/{device}"))
+      assert [owner.split(":")[0] for owner in owners] == [
+          "hostA", "hostB"], (device, sorted(replicas))
+
+  def test_drift_rollup_quarantines_host_by_name(self, pod):
+    from tensor2robot_tpu.serving.slo import RequestShed, SLOClass
+
+    door = pod["door"]
+    predictor = pod["predictor"]
+    device0 = pod["devices"][0]
+    # The aggregate health rollup's shape, naming hostB's replica
+    # divergent under its host:pid/replica key.
+    process_key = f"hostB:{os.getpid()}"
+    named = door.apply_drift_rollup(
+        {"q_drift": {"divergent": [f"{process_key}/{device0}"]}},
+        {process_key: "hostB"})
+    assert named == [f"hostB:{device0}"]
+    snap = door.snapshot()
+    assert snap["hosts"]["hostB"]["quarantined"], snap["hosts"]
+    events = [entry for entry in snap["timeline"]
+              if entry["event"] == "host_quarantined"]
+    assert events and events[0]["host"] == "hostB"
+    assert events[0]["replica"] == device0
+    assert events[0]["reason"] == "q_drift"
+    # All new ingress lands on the healthy host.
+    before = door.snapshot()["hosts"]
+    futures = [door.submit(predictor.make_image(100 + i))
+               for i in range(6)]
+    for future in futures:
+      future.result(timeout=30)
+    after = door.snapshot()["hosts"]
+    assert after["hostB"]["submitted"] == before["hostB"]["submitted"]
+    assert after["hostA"]["submitted"] == (
+        before["hostA"]["submitted"] + 6)
+    # The ingress deadline stamp composes across the hop: a budget
+    # consumed upstream sheds as expired at the replica, not served.
+    dead = SLOClass("spent", 1, -5.0)
+    with pytest.raises(RequestShed) as info:
+      door.act(predictor.make_image(0), slo=dead, timeout=10)
+    assert info.value.reason == "expired"
+    door.reinstate_host("hostB")
+    final = door.snapshot()
+    assert not final["hosts"]["hostB"]["quarantined"]
+    assert final["reconciled"], final
+
+
 class TestFlightRecorderRound13:
   """ISSUE 12 satellite: per-recorder instances + the repoint warning
   + trigger context in dumps."""
