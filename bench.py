@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-ROUND = 19
+ROUND = 20
 DETAIL_FILE = f"BENCH_DETAIL_r{ROUND:02d}.json"
 
 WARMUP_LOOPS = 2
@@ -1179,6 +1179,52 @@ def _bench_multihost_compact():
   }
 
 
+def _bench_sebulba_compact():
+  """Sebulba decoupled tier for the bench detail (ISSUE 20).
+
+  The committed chipless artifact (SEBULBA_r20.json) carries the full
+  protocol — 2 REAL CEM actor processes streaming fixed-shape chunks
+  through the spool transport + bounded TransitionQueue into the
+  2-device sharded learner behind the double-buffered device_put
+  prefetch seam, the serialized one-process oracle bit-parity pair
+  (params AND megastep metric stream), and the kill-one-actor
+  watchdog -> quarantine -> probe -> reinstate run with zero learner
+  recompiles — where throughput keys are null by the virtual-mesh
+  honesty rule. This block is the driver-refreshable counterpart at
+  reduced scale: synthetic actors (numpy-only subprocesses, so the
+  decoupled structure re-runs live on any host) with bars deferred to
+  the compact sentinels. The learner itself needs two local devices
+  to shard across; a single-chip window reports the skip honestly.
+  """
+  import tempfile
+  from tensor2robot_tpu.parallel.sebulba_bench import (
+      measure_actor_outage, measure_decoupled_overlap)
+  if len(jax.devices()) < 2:
+    return {"skipped": "sharded Sebulba learner needs >= 2 local "
+                       "devices; committed artifact: SEBULBA_r20.json"}
+  with tempfile.TemporaryDirectory() as workdir:
+    overlap = measure_decoupled_overlap(
+        os.path.join(workdir, "overlap"), seed=0, enforce_bars=False,
+        synthetic=True, num_megasteps=3)
+    outage = measure_actor_outage(
+        os.path.join(workdir, "outage"), seed=0, enforce_bars=False)
+  return {
+      "decoupled_overlap": overlap,
+      "actor_outage": outage,
+      "sebulba_actor_processes": (
+          2 if all(value is not False
+                   for value in overlap.get("bars", {}).values())
+          else None),
+      "sebulba_oracle_bit_identical": overlap.get(
+          "params_parity", {}).get("bit_identical"),
+      "sebulba_outage_reinstated": (
+          all(value is not False
+              for value in outage.get("bars", {}).values()) or None),
+      "sebulba_overlap_fraction": overlap.get(
+          "overlap", {}).get("overlap_fraction"),
+  }
+
+
 def _bench_learner_compact():
   """Learner-throughput block for the bench detail (ISSUE 4).
 
@@ -1370,6 +1416,11 @@ def main() -> None:
   except Exception as e:
     multihost = {"error": f"{type(e).__name__}: {e}"}
 
+  try:
+    sebulba = _bench_sebulba_compact()
+  except Exception as e:
+    sebulba = {"error": f"{type(e).__name__}: {e}"}
+
   mfu = None
   if peak and headline_flops:
     # headline flops from its own executable (uint8 variant's math).
@@ -1436,6 +1487,7 @@ def main() -> None:
       "tpquant": tpquant,
       "flywheel": flywheel,
       "multihost": multihost,
+      "sebulba": sebulba,
   }
   with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          DETAIL_FILE), "w") as f:
@@ -1544,6 +1596,21 @@ def main() -> None:
       "multihost_processes": multihost.get("multihost_processes"),
       "fused_resume_parity_ok": multihost.get("fused_resume_parity_ok"),
       "frontdoor_p99_headroom": multihost.get("frontdoor_p99_headroom"),
+      # Sebulba decoupled-tier sentinels (ISSUE 20): how many REAL
+      # actor processes fed the sharded learner with every structural
+      # bar holding (null otherwise or when the window lacks two
+      # devices), whether the live learner's params matched the
+      # serialized one-process oracle bit for bit, whether the
+      # kill-one-actor quarantine -> probe -> reinstate walk held
+      # with zero recompiles, and the measured actor-busy/learner-wall
+      # overlap fraction. Null-safe under skip/error like every
+      # compact key.
+      "sebulba_actor_processes": sebulba.get("sebulba_actor_processes"),
+      "sebulba_oracle_bit_identical": sebulba.get(
+          "sebulba_oracle_bit_identical"),
+      "sebulba_outage_reinstated": sebulba.get(
+          "sebulba_outage_reinstated"),
+      "sebulba_overlap_fraction": sebulba.get("sebulba_overlap_fraction"),
       "device_kind": device_kind,
       "detail": DETAIL_FILE,
   }))

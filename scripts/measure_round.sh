@@ -350,6 +350,28 @@ else
     python -m tensor2robot_tpu.bin.bench_multihost --smoke \
       --out "$STAGE_TMP"'
 fi
+# Twelfth chipless backstop (ISSUE 20): the Sebulba decoupled tier —
+# 2 REAL CEM actor processes streaming fixed-shape chunks through the
+# spool transport + bounded TransitionQueue into the 2-device sharded
+# learner behind the double-buffered device_put prefetch seam, the
+# serialized one-process oracle bit-parity pair (params AND megastep
+# metric stream), and the kill-one-actor watchdog -> quarantine ->
+# probe -> reinstate run with zero learner recompiles. Throughput keys
+# are null by the virtual-mesh honesty rule. Pytest deferral matters:
+# the run spawns real actor subprocesses on a small host and the
+# watchdog deadlines are wall-clock.
+if [ -s "SEBULBA_${RTAG}.json" ]; then
+  log "skip SEBULBA_${RTAG}.json (exists)"
+else
+  while pgrep -f "python -m pytest" >/dev/null 2>&1 \
+      && [ "$(date +%s)" -lt "$deadline" ]; do
+    log "deferring sebulba backstop: pytest is running"
+    sleep 60
+  done
+  run_stage "SEBULBA_${RTAG}.json" 3000 sh -c '
+    python -m tensor2robot_tpu.bin.bench_sebulba --smoke \
+      --out "$STAGE_TMP"'
+fi
 while [ "$(date +%s)" -lt "$deadline" ]; do
   # Never perturb a live test run: the probe's jax import is real CPU
   # on a small host, and the serving smoke's amortization bar is a

@@ -1,0 +1,25 @@
+"""Sebulba decoupled-tier CLI: the bin/ face of parallel/sebulba_bench.
+
+    # The committed SEBULBA_r20 protocol (chipless: 2 REAL CEM actor
+    # processes + 1 sharded learner process on virtual CPU devices;
+    # acceptance bars are ENFORCED at generation time):
+    python -m tensor2robot_tpu.bin.bench_sebulba --smoke --out SEBULBA_r20.json
+
+    # Reduced tier-1 lane (synthetic actors, bars deferred):
+    python -m tensor2robot_tpu.bin.bench_sebulba --ci
+
+Everything — the 2-actor-process spool transport with bounded ack
+backpressure, the double-buffered device_put ingest seam feeding the
+sharded ring's exactly-once device_extend, the serialized one-process
+oracle whose params must match the live learner BIT for bit, and the
+kill-one-actor watchdog -> quarantine -> probe -> reinstate protocol
+with zero learner recompiles — lives in parallel/sebulba_bench.py (the
+machinery itself in parallel/sebulba.py); this wrapper exists so the
+decoupled tier is discoverable next to bench_multihost in the bin/
+surface every other measured artifact is produced from.
+"""
+
+from tensor2robot_tpu.parallel.sebulba_bench import main
+
+if __name__ == "__main__":
+  main()

@@ -5,6 +5,13 @@ native-components table, "Host→device feeding"): while the device crunches
 step N, the next host batch is already being transferred — `jax.device_put`
 with a `NamedSharding` is asynchronous, so holding `depth` in-flight batches
 overlaps H2D DMA with compute without any explicit infeed machinery.
+
+ISSUE 20 instruments the seam: in-flight depth and bytes flow through the
+typed `obs/registry` writer (gauges ``<name>/depth`` and
+``<name>/in_flight_bytes``, counter ``<name>/batches``), and a consumer
+that must distinguish "stream ended" from "iterator bug" can opt into the
+typed `PrefetchExhausted` instead of a bare `StopIteration` escaping a
+generator frame (which Python would mangle into a RuntimeError anyway).
 """
 
 from __future__ import annotations
@@ -13,12 +20,36 @@ import collections
 from typing import Any, Iterator, Optional
 
 import jax
+import numpy as np
+
+
+class PrefetchExhausted(Exception):
+  """The upstream host iterator ended and every in-flight transfer has
+  been yielded. Raised (instead of bare StopIteration) when the
+  consumer passed ``exhaust_error=True`` — a learner loop catches THIS
+  at its ingest seam rather than letting generator-protocol mechanics
+  leak through as RuntimeError('generator raised StopIteration')."""
+
+  def __init__(self, name: str, batches: int):
+    super().__init__(
+        f"prefetch stream {name!r} exhausted after {batches} batches")
+    self.name = name
+    self.batches = batches
+
+
+def _host_nbytes(batch: Any) -> int:
+  """Byte size of a host pytree BEFORE transfer (what H2D will move)."""
+  return sum(np.asarray(leaf).nbytes
+             for leaf in jax.tree_util.tree_leaves(batch))
 
 
 def prefetch_to_device(
     iterator: Iterator[Any],
     sharding: Optional[Any] = None,
     depth: int = 2,
+    registry: Optional[Any] = None,
+    name: str = "prefetch",
+    exhaust_error: bool = False,
 ) -> Iterator[Any]:
   """Yields batches moved to device, keeping `depth` transfers in flight.
 
@@ -30,9 +61,22 @@ def prefetch_to_device(
       mesh; None = default device placement.
     depth: number of batches resident on device. 2 = classic double
       buffering; more helps jittery input pipelines at the cost of HBM.
+    registry: a `MetricRegistry`; defaults to the process registry.
+      Gauges ``<name>/depth`` / ``<name>/in_flight_bytes`` track the
+      buffer after every transition; counter ``<name>/batches`` counts
+      yields.
+    name: metric namespace for this stream.
+    exhaust_error: when True, raise `PrefetchExhausted` after the final
+      buffered batch instead of ending by StopIteration.
   """
   if depth < 1:
     raise ValueError(f"depth must be >= 1, got {depth}")
+  if registry is None:
+    from tensor2robot_tpu.obs.registry import get_registry
+    registry = get_registry()
+  depth_gauge = registry.gauge(f"{name}/depth")
+  bytes_gauge = registry.gauge(f"{name}/in_flight_bytes")
+  batches_counter = registry.counter(f"{name}/batches")
 
   def transfer(batch: Any) -> Any:
     if sharding is None:
@@ -40,9 +84,30 @@ def prefetch_to_device(
     return jax.device_put(batch, sharding)
 
   buffer: collections.deque = collections.deque()
-  for batch in iterator:
+  in_flight_bytes: collections.deque = collections.deque()
+  yielded = 0
+
+  def push(batch: Any) -> None:
+    in_flight_bytes.append(_host_nbytes(batch))
     buffer.append(transfer(batch))
+    depth_gauge.set(len(buffer))
+    bytes_gauge.set(sum(in_flight_bytes))
+
+  def pop() -> Any:
+    in_flight_bytes.popleft()
+    batch = buffer.popleft()
+    depth_gauge.set(len(buffer))
+    bytes_gauge.set(sum(in_flight_bytes))
+    batches_counter.inc()
+    return batch
+
+  for batch in iterator:
+    push(batch)
     if len(buffer) >= depth:
-      yield buffer.popleft()
+      yielded += 1
+      yield pop()
   while buffer:
-    yield buffer.popleft()
+    yielded += 1
+    yield pop()
+  if exhaust_error:
+    raise PrefetchExhausted(name, yielded)

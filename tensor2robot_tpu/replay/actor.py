@@ -151,9 +151,7 @@ class VectorActor:
     heartbeat = self._watchdog.register("act/vector_actor")
     try:
       while not self._stop.is_set():
-        start = time.perf_counter()
         self.step_once()
-        self.busy_seconds += time.perf_counter() - start
         heartbeat.beat()
     except BaseException as e:  # noqa: BLE001 — surfaced via stop()
       self.errors.append(e)
@@ -170,7 +168,13 @@ class VectorActor:
     transition's observation/next_image must be the OLD scene (static
     scene, no bootstrap leak across the reset — the scalar path's
     `[scene] * (t + 1)` episode stack holds the same invariant).
+
+    Owns its busy accounting (moved here from `_run` for ISSUE 20):
+    the Sebulba actor process drives step_once directly without ever
+    starting the thread, and the overlap instrument must not care
+    which driver is calling.
     """
+    begin = time.perf_counter()
     env = self._env
     n = env.num_envs
     scenes = env.images.copy()
@@ -198,6 +202,7 @@ class VectorActor:
         "done": dones,
         "next_image": scenes,
     })
+    self.busy_seconds += time.perf_counter() - begin
 
 
 class ActorFleet:

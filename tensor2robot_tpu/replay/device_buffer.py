@@ -486,6 +486,49 @@ class DeviceReplayBuffer:
         self._ledger.record_dispatch("device_extend",
                                      time.perf_counter() - start)
 
+  def extend_device_chunk(self, chunk) -> int:
+    """Ingests one already-device-resident fixed-size chunk (ISSUE 20).
+
+    The Sebulba learner seam: chunks arrive through the prefetch
+    double-buffer as device arrays, so routing them through `extend`
+    would force a device->host->device round trip (`np.asarray` on a
+    jax array materializes it). This path dispatches the SAME
+    ``device_extend`` executable directly — exactly-once on the ledger
+    whichever seam feeds the ring. Requires exactly ``ingest_chunk``
+    rows (the one shape the executable exists for) and an empty
+    host-side staging area (interleaving with partially-staged host
+    rows would reorder the ring).
+    """
+    chunk = dict(chunk)
+    if set(chunk) != set(self._spec):
+      raise ValueError(
+          f"chunk keys {sorted(chunk)} != spec keys "
+          f"{sorted(self._spec)}")
+    for key, array in chunk.items():
+      expected = (self.ingest_chunk,) + tuple(self._spec[key].shape)
+      if tuple(array.shape) != expected:
+        raise ValueError(
+            f"device chunk {key!r} has shape {tuple(array.shape)}, "
+            f"expected {expected} (ingest_chunk={self.ingest_chunk})")
+    with self._lock:
+      if self._pending_count:
+        raise RuntimeError(
+            f"extend_device_chunk with {self._pending_count} host rows "
+            "staged: flushing out of order would scramble the ring. "
+            "Use one ingest seam per buffer.")
+      if self._extend_exec is None:
+        self._extend_exec = self._compile(
+            "device_extend", self.extend_fn(), (self._state, chunk),
+            donate=(0,))
+      with trace_lib.span("extend/device_chunk",
+                          chunk=self.ingest_chunk):
+        start = time.perf_counter()
+        self._state = self._extend_exec(self._state, chunk)
+        if self._ledger is not None:
+          self._ledger.record_dispatch("device_extend",
+                                       time.perf_counter() - start)
+    return self.ingest_chunk
+
   def sample(self) -> Tuple[ts.TensorSpecStruct, SampleInfo]:
     """One fixed-shape batch + SampleInfo, as host numpy (ReplayBuffer
     drop-in for tests/interop; the megastep inlines sample_fn instead

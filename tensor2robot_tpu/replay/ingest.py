@@ -101,9 +101,13 @@ class TransitionQueue:
   spans chunks from both worlds.
   """
 
-  def __init__(self, capacity: int):
+  def __init__(self, capacity: int, *,
+               registry=None, flight_recorder=None,
+               overflow_dump_threshold: int = 8):
     if capacity < 1:
       raise ValueError(f"capacity must be >= 1, got {capacity}")
+    from tensor2robot_tpu.obs import flight_recorder as flight_lib
+    from tensor2robot_tpu.obs import registry as registry_lib
     self.capacity = capacity
     self._items: Deque[Tuple[Dict[str, np.ndarray], str]] = deque()
     self._rows = 0
@@ -111,6 +115,20 @@ class TransitionQueue:
     self.enqueued = 0
     self.dropped = 0
     self.dequeued = 0
+    # Drop observability (ISSUE 20): at Sebulba rates a saturated queue
+    # sheds continuously, and the in-object `dropped` counter only
+    # surfaces if a loop's metrics block happens to export it. The
+    # typed-registry counter makes shedding first-class everywhere the
+    # registry flushes; SUSTAINED overflow (every one of
+    # `overflow_dump_threshold` consecutive puts shed rows) is a
+    # flight-recorder trigger — that regime means the consumer is
+    # wedged, not momentarily slow.
+    self._registry = registry or registry_lib.get_registry()
+    self._dropped_counter = self._registry.counter(
+        "replay/transition_queue_dropped")
+    self._recorder = flight_recorder or flight_lib.get_recorder()
+    self._overflow_dump_threshold = overflow_dump_threshold
+    self._overflow_streak = 0
 
   def put_episode(self, episode: Mapping[str, np.ndarray],
                   provenance: str = "synthetic") -> int:
@@ -157,6 +175,7 @@ class TransitionQueue:
     n = sizes.pop()
     if n == 0:
       return 0
+    shed = 0
     with self._lock:
       self.enqueued += n
       if n >= self.capacity:
@@ -167,14 +186,33 @@ class TransitionQueue:
              for key, value in chunk.items()}, provenance))
         self._rows = self.capacity
         self.dropped += shed
-        return n
-      overflow = self._rows + n - self.capacity
-      if overflow > 0:
-        _, shed = self._pop_rows_locked(overflow)
-        self.dropped += shed
-      self._items.append((chunk, provenance))
-      self._rows += n
+      else:
+        overflow = self._rows + n - self.capacity
+        if overflow > 0:
+          _, shed = self._pop_rows_locked(overflow)
+          self.dropped += shed
+        self._items.append((chunk, provenance))
+        self._rows += n
+    # Outside the lock on purpose: the sustained-overflow trigger does
+    # file I/O (flight-recorder dump), and put_batch sits on the actor
+    # hot path — producers must never serialize behind a dump.
+    self._note_shedding(shed)
     return n
+
+  def _note_shedding(self, shed: int) -> None:
+    if shed <= 0:
+      self._overflow_streak = 0
+      return
+    self._dropped_counter.inc(shed)
+    self._overflow_streak += 1
+    if self._overflow_streak >= self._overflow_dump_threshold:
+      self._recorder.trigger(
+          "transition_queue_sustained_overflow",
+          consecutive_overflow_puts=self._overflow_streak,
+          dropped_total=self.dropped,
+          pending=self._rows,
+          capacity=self.capacity)
+      self._overflow_streak = 0
 
   def _pop_rows_locked(self, limit: int):
     """Pops up to `limit` rows of chunks off the head (sliced when the

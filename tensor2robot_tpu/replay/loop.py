@@ -576,7 +576,9 @@ class ReplayTrainLoop:
       self.buffer = ReplayBuffer(
           spec, config.capacity, config.batch_size, seed=config.seed,
           prioritized=config.prioritized)
-    self.queue = TransitionQueue(config.queue_capacity)
+    self.queue = TransitionQueue(config.queue_capacity,
+                                 registry=self.registry,
+                                 flight_recorder=self.recorder)
     self.feeder = ReplayFeeder(self.queue, self.buffer, config.min_fill)
     self.compile_counts: Dict[str, int] = {}
     self._collectors: List[CollectorWorker] = []

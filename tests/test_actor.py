@@ -387,3 +387,69 @@ class TestVectorActorSmokeCLI:
     assert sum(1 for key in counts if key.startswith("scalar_cem")) == 1
     assert sum(1 for key in counts if key.startswith("vector_cem")) == 1
     assert all(value == 1 for value in counts.values()), counts
+
+
+class TestActorProcessCrashRecovery:
+  """ISSUE 20 satellite: a Sebulba actor PROCESS dies mid-stream; the
+  learner-side watchdog flags the silent spool, the breaker walks
+  quarantine -> half-open probe -> reinstate, and the learner trains
+  through on the survivor at fixed shapes with zero recompiles."""
+
+  @pytest.fixture(scope="class")
+  def crash_run(self, tmp_path_factory):
+    from tensor2robot_tpu.parallel import sebulba
+    config = sebulba.SebulbaConfig(
+        seed=11, num_actors=2, envs_per_actor=8, capacity=64,
+        batch_size=8, inner_steps=1, chunks_per_megastep=2,
+        num_megasteps=10, mesh_devices=2, queue_capacity=96,
+        synthetic_actors=True, actor_max_chunks=512,
+        actor_deadline_s=0.25, quarantine_s=0.5,
+        actor_step_sleep_s=0.05)
+    workdir = str(tmp_path_factory.mktemp("sebulba_crash"))
+    return config, sebulba.run_live(config, workdir,
+                                    die_after={0: 3}, timeout_s=240.0)
+
+  def test_two_real_processes_and_rc3_crash(self, crash_run):
+    _, live = crash_run
+    quarantine = next(entry for entry in live["supervisor"]["timeline"]
+                      if entry["event"] == "quarantine")
+    assert quarantine["actor"] == 0
+    assert quarantine["rc"] == 3  # the injected os._exit(3), not a kill
+    spawn_pids = {entry["pid"] for entry in live["supervisor"]["timeline"]
+                  if entry["event"] == "spawn"}
+    assert len(spawn_pids) == 2 and os.getpid() not in spawn_pids
+
+  def test_watchdog_flagged_the_silent_actor(self, crash_run):
+    _, live = crash_run
+    stalls = [event for event in live["watchdog_events"]
+              if event["event"] == "watchdog_stall"]
+    assert any(event["component"].startswith("sebulba/actor0")
+               for event in stalls), live["watchdog_events"]
+    for event in stalls:  # PR 9 typed stall schema rides along
+      assert {"component", "stalled_for_s", "deadline_s",
+              "beats"} <= set(event)
+
+  def test_quarantine_probe_reinstate_in_order(self, crash_run):
+    _, live = crash_run
+    events0 = [entry["event"] for entry in live["supervisor"]["timeline"]
+               if entry["actor"] == 0 and entry["event"] != "spawn"]
+    assert events0 == ["quarantine", "probe", "reinstate"], events0
+    breaker0 = [entry["state"] for entry
+                in live["supervisor"]["breaker_events"]["0"]]
+    assert breaker0 == ["open", "half_open", "closed"], breaker0
+
+  def test_probe_resumes_seq_and_refeeds_learner(self, crash_run):
+    config, live = crash_run
+    probe = next(entry for entry in live["supervisor"]["timeline"]
+                 if entry["event"] == "probe")
+    assert probe["start_seq"] >= 3  # never overwrites landed chunks
+    consumed0 = [entry["seq"] for entry in live["manifest"]
+                 if entry["actor"] == 0]
+    assert max(consumed0) >= 3, consumed0  # post-death chunk ingested
+    assert any(entry["actor"] == 1 for entry in live["manifest"])
+
+  def test_learner_trained_through_at_fixed_shapes(self, crash_run):
+    config, live = crash_run
+    assert live["drive"]["megasteps"] == config.num_megasteps
+    assert live["compile_counts"] == {"device_extend": 1, "megastep": 1}
+    assert live["queue"]["dropped"] == 0
